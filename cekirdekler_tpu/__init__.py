@@ -1,0 +1,45 @@
+"""cekirdekler_tpu — a TPU-native multi-chip compute framework.
+
+A from-scratch, TPU-first framework with the capabilities of the reference
+C#/OpenCL Cekirdekler API: treat all chips of a TPU slice as one device for
+user-supplied kernels.  Kernels (an OpenCL-C-like subset, Python functions,
+or raw Pallas) are JIT-compiled via XLA and dispatched across chips with an
+iterative, per-compute-id load balancer; host arrays stage through pinned
+aligned buffers; transfer/compute overlap rides XLA async dispatch; pipeline
+stages exchange data over ICI collectives; pools, a cluster tier, and
+sequence/tensor parallel utilities sit on top.
+"""
+
+from .arrays import ClArray, FastArr, FloatArr, IntArr, ParameterGroup, TransferFlags, wrap
+from .errors import (
+    CekirdeklerError,
+    ComputeValidationError,
+    DeviceSelectionError,
+    KernelCompileError,
+    KernelLanguageError,
+)
+from .hardware import AcceleratorType, Device, Devices, Platform, Platforms, all_devices, platforms
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "AcceleratorType",
+    "CekirdeklerError",
+    "ClArray",
+    "ComputeValidationError",
+    "Device",
+    "DeviceSelectionError",
+    "Devices",
+    "FastArr",
+    "FloatArr",
+    "IntArr",
+    "KernelCompileError",
+    "KernelLanguageError",
+    "ParameterGroup",
+    "Platform",
+    "Platforms",
+    "TransferFlags",
+    "all_devices",
+    "platforms",
+    "wrap",
+]

@@ -1,0 +1,220 @@
+"""FastArr — page-aligned native host arrays for fast host↔HBM DMA.
+
+TPU-native analogue of the reference's ``CSpaceArrays.FastArr<T>`` family
+(CSpaceArrays.cs:154-1517): arrays allocated 4096-byte-aligned in the C++
+heap so device transfers avoid unaligned staging.  The reference uses them
+for OpenCL ``CL_MEM_USE_HOST_PTR`` zero-copy buffers; here they are the
+pinned staging buffers handed to ``jax.device_put`` (the ``zero_copy`` array
+flag maps to "pinned staging", see SURVEY.md §7 hard parts).
+
+Each FastArr owns one native allocation (via native/kutuphane_tpu.cpp) and
+exposes it as a zero-copy numpy view.  When the native library is not
+available (no toolchain), falls back to a manually aligned numpy buffer —
+same alignment guarantee, host-heap allocation.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Any
+
+import numpy as np
+
+from ..native import load as _load_native
+
+__all__ = [
+    "FastArr",
+    "FloatArr",
+    "DoubleArr",
+    "IntArr",
+    "UIntArr",
+    "LongArr",
+    "ByteArr",
+    "HalfArr",
+    "BFloat16Arr",
+    "fast_arr_for_dtype",
+    "ALIGNMENT",
+]
+
+ALIGNMENT = 4096
+
+# type codes — numerically identical to the reference's ARR_* constants
+# (CSpaceArrays.cs:48-78) so the cluster wire format stays self-describing.
+_TYPE_CODES: dict[str, int] = {
+    "float32": 0,
+    "float64": 1,
+    "int32": 2,
+    "int64": 3,
+    "uint32": 4,
+    "uint8": 5,
+    "uint16": 6,   # reference's UTF-16 char slot
+    "bfloat16": 7,
+    "bool": 8,
+}
+
+
+def _aligned_numpy(nbytes: int, alignment: int) -> tuple[np.ndarray, None]:
+    """Fallback aligned buffer carved out of an oversized numpy allocation."""
+    raw = np.zeros(nbytes + alignment, dtype=np.uint8)
+    addr = raw.ctypes.data
+    offset = (-addr) % alignment
+    view = raw[offset : offset + nbytes]
+    # keep `raw` alive through the view's base chain
+    return view, None
+
+
+class FastArr:
+    """Aligned native host array (reference: FastArr<T> base,
+    CSpaceArrays.cs:229-404).
+
+    Not bounds-checked beyond numpy's own checks (the reference's FastArr has
+    *no* bounds checks at all, README.md:38-40 — we keep numpy's).
+    """
+
+    def __init__(self, n: int, dtype: Any):
+        self.dtype = np.dtype(dtype)
+        self.n = int(n)
+        nbytes = self.n * self.dtype.itemsize
+        self._nbytes = nbytes
+        self._lib = _load_native()
+        self._raw: int | None = None
+        if nbytes <= 0:
+            self._np = np.empty(0, dtype=self.dtype)
+            self._backing = None
+            return
+        if self._lib is not None:
+            ptr = self._lib.ck_createArray(nbytes, ALIGNMENT)
+            if ptr:
+                self._raw = ptr
+                buf = (ctypes.c_uint8 * nbytes).from_address(ptr)
+                view = np.frombuffer(buf, dtype=np.uint8)
+                self._np = view.view(self.dtype)[: self.n]
+                self._backing = buf
+                return
+        view, _ = _aligned_numpy(nbytes, ALIGNMENT)
+        self._np = view.view(self.dtype)[: self.n]
+        self._backing = view
+
+    # -- memory handle surface (reference: IMemoryHandle,
+    #    CSpaceArrays.cs:154-186) ------------------------------------------
+    @property
+    def is_native(self) -> bool:
+        return self._raw is not None
+
+    def address(self) -> int:
+        """Aligned head address (reference: ha(), CSpaceArrays.cs:371-374)."""
+        return int(self._np.ctypes.data)
+
+    @property
+    def nbytes(self) -> int:
+        return self._nbytes
+
+    def numpy(self) -> np.ndarray:
+        """Zero-copy numpy view of the aligned storage."""
+        return self._np
+
+    def to_array(self) -> np.ndarray:
+        """Copy out (reference: ToArray(), CSpaceArrays.cs:396-404)."""
+        return self._np.copy()
+
+    # -- IMemoryOperations<T> surface (CSpaceArrays.cs:188-224) -------------
+    def copy_from(self, src, offset: int = 0) -> None:
+        src_np = np.asarray(src, dtype=self.dtype).ravel()
+        self._np[offset : offset + src_np.size] = src_np
+
+    def copy_to(self, dst: np.ndarray, offset: int = 0) -> None:
+        n = min(self.n - offset, dst.size)
+        np.copyto(dst.ravel()[:n], self._np[offset : offset + n])
+
+    def fill(self, value) -> None:
+        self._np[:] = value
+
+    # -- sequence-ish protocol ----------------------------------------------
+    def __len__(self) -> int:
+        return self.n
+
+    def __getitem__(self, idx):
+        return self._np[idx]
+
+    def __setitem__(self, idx, value):
+        self._np[idx] = value
+
+    def __array__(self, dtype=None, copy=None):
+        if dtype is None or np.dtype(dtype) == self.dtype:
+            return self._np if not copy else self._np.copy()
+        return self._np.astype(dtype)
+
+    def dispose(self) -> None:
+        """Release native storage (reference: deleteArray path,
+        CSpaceArrays.cs:139-147)."""
+        if self._raw is not None and self._lib is not None:
+            lib, raw, nbytes = self._lib, self._raw, self._nbytes
+            self._raw = None
+            self._np = np.empty(0, dtype=self.dtype)
+            self._backing = None
+            lib.ck_deleteArray(raw, nbytes, ALIGNMENT)
+
+    def __del__(self):  # pragma: no cover - GC timing dependent
+        try:
+            self.dispose()
+        except Exception:
+            pass
+
+    def __repr__(self) -> str:  # pragma: no cover - cosmetic
+        kind = "native" if self.is_native else "fallback"
+        return f"{type(self).__name__}(n={self.n}, dtype={self.dtype}, {kind})"
+
+
+# typed subclasses (reference: ClFloatArray..ClCharArray,
+# CSpaceArrays.cs:582-1393); bfloat16 is the TPU-native addition.
+class FloatArr(FastArr):
+    def __init__(self, n: int):
+        super().__init__(n, np.float32)
+
+
+class DoubleArr(FastArr):
+    def __init__(self, n: int):
+        super().__init__(n, np.float64)
+
+
+class IntArr(FastArr):
+    def __init__(self, n: int):
+        super().__init__(n, np.int32)
+
+
+class UIntArr(FastArr):
+    def __init__(self, n: int):
+        super().__init__(n, np.uint32)
+
+
+class LongArr(FastArr):
+    def __init__(self, n: int):
+        super().__init__(n, np.int64)
+
+
+class ByteArr(FastArr):
+    def __init__(self, n: int):
+        super().__init__(n, np.uint8)
+
+
+class HalfArr(FastArr):
+    def __init__(self, n: int):
+        super().__init__(n, np.float16)
+
+
+class BFloat16Arr(FastArr):
+    def __init__(self, n: int):
+        import ml_dtypes  # ships with jax
+
+        super().__init__(n, ml_dtypes.bfloat16)
+
+
+def type_code_for_dtype(dtype) -> int:
+    name = np.dtype(dtype).name
+    if name not in _TYPE_CODES:
+        raise TypeError(f"unsupported FastArr dtype: {name}")
+    return _TYPE_CODES[name]
+
+
+def fast_arr_for_dtype(n: int, dtype) -> FastArr:
+    return FastArr(n, dtype)

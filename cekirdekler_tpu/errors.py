@@ -1,0 +1,47 @@
+"""Error surface for the framework.
+
+The reference collects per-worker compile errors into an aggregated message and
+refuses further compute once any error happened (Cores.cs:264-272,
+ClArray.cs:1610-1623 ``numberOfErrorsHappened``).  We raise typed exceptions
+instead, but keep an error counter on the cruncher for API parity.
+"""
+
+from __future__ import annotations
+
+
+class CekirdeklerError(Exception):
+    """Base class for all framework errors."""
+
+
+class KernelCompileError(CekirdeklerError):
+    """Kernel-string compilation failed (reference: ClProgram build error,
+    ClProgram.cs:62-73)."""
+
+    def __init__(self, message: str, source: str | None = None, line: int | None = None):
+        self.source = source
+        self.line = line
+        if line is not None:
+            message = f"line {line}: {message}"
+        super().__init__(message)
+
+
+class KernelLanguageError(KernelCompileError):
+    """Kernel uses a construct outside the supported TPU kernel contract."""
+
+
+class ComputeValidationError(CekirdeklerError):
+    """Invalid compute() arguments (reference: ClArray.cs:1625-1679 /
+    ClParameterGroup validation, ClArray.cs:543-645)."""
+
+
+class DeviceSelectionError(CekirdeklerError):
+    """No devices matched the query (reference: Cores error strings when no
+    devices are found, Cores.cs:186-246)."""
+
+
+class ClusterError(CekirdeklerError):
+    """Cluster tier failure (connection, protocol, or remote compute error)."""
+
+
+class PoolError(CekirdeklerError):
+    """Task/device pool misuse or scheduling failure."""

@@ -1,0 +1,385 @@
+"""Hardware query DSL — platform/device discovery and fluent selection.
+
+TPU-native analogue of the reference's ``Hardware.ClPlatforms`` /
+``Hardware.ClDevices`` (ClObjectApi.cs:36-109,158-775,781-1272): a fluent,
+copy-on-select device query API whose results feed the ``NumberCruncher``
+constructor.  Platforms map to JAX/PJRT backends (``tpu``, ``cpu``, …);
+devices map to ``jax.Device`` chips.  The reference's vendor filters
+(intel/amd/nvidia/altera/xilinx) become backend/device-kind filters; its
+micro-benchmark ranking ``devicesWithHighestDirectNbodyPerformance``
+(ClObjectApi.cs:1222-1244) is reproduced by running the nbody workload on each
+chip.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Callable, Iterable, Iterator, Sequence
+
+import jax
+
+from .errors import DeviceSelectionError
+
+__all__ = [
+    "AcceleratorType",
+    "Device",
+    "Devices",
+    "Platform",
+    "Platforms",
+    "platforms",
+    "all_devices",
+]
+
+
+class AcceleratorType(enum.IntFlag):
+    """Device-type selection flags (reference: AcceleratorType used by the
+    ClNumberCruncher ctor, ClNumberCruncher.cs:199-248).
+
+    ``GPU`` and ``ACC`` both select TPU chips on this platform; ``CPU``
+    selects host (CPU backend) devices — including the virtual multi-device
+    CPU rig used for testing multi-chip scheduling.
+    """
+
+    NONE = 0
+    CPU = 1
+    GPU = 2   # historical alias: on a TPU system the "GPU-class" device is the TPU
+    ACC = 4   # accelerators == TPU
+    TPU = 8
+    ALL = CPU | GPU | ACC | TPU
+
+
+_ACCEL_BACKENDS = ("tpu", "axon", "gpu", "cuda", "rocm")
+
+
+def _backend_matches(platform_name: str, want: AcceleratorType) -> bool:
+    is_accel = platform_name in _ACCEL_BACKENDS
+    if want & (AcceleratorType.TPU | AcceleratorType.GPU | AcceleratorType.ACC):
+        if is_accel:
+            return True
+    if want & AcceleratorType.CPU and platform_name == "cpu":
+        return True
+    return False
+
+
+@dataclass(frozen=True)
+class Device:
+    """One compute chip (reference: ClDevice, ClDevice.cs:29-240).
+
+    Wraps a ``jax.Device``.  ``dedicated_memory`` mirrors the reference's
+    ``deviceGDDR`` flag (dedicated vs host-shared memory,
+    ClDevice.cs:105-108): True for real TPU HBM, False for CPU backend
+    devices.
+    """
+
+    jax_device: jax.Device
+    partition_cores: int = 0  # >0 => virtual sub-device (CPU fission analogue)
+
+    @property
+    def platform(self) -> str:
+        return self.jax_device.platform
+
+    @property
+    def name(self) -> str:
+        return f"{self.jax_device.device_kind} #{self.jax_device.id}"
+
+    @property
+    def vendor(self) -> str:
+        return "Google" if self.is_tpu else "host"
+
+    @property
+    def is_tpu(self) -> bool:
+        return self.jax_device.platform in _ACCEL_BACKENDS
+
+    @property
+    def is_cpu(self) -> bool:
+        return self.jax_device.platform == "cpu"
+
+    @property
+    def dedicated_memory(self) -> bool:
+        return self.is_tpu
+
+    @property
+    def compute_units(self) -> int:
+        """Core count analogue (reference: deviceComputeUnits)."""
+        if self.partition_cores:
+            return self.partition_cores
+        try:
+            return int(getattr(self.jax_device, "num_cores", 1) or 1)
+        except Exception:
+            return 1
+
+    @property
+    def memory_bytes(self) -> int:
+        """Device memory capacity (reference: deviceMemSize)."""
+        try:
+            stats = self.jax_device.memory_stats()
+            if stats and "bytes_limit" in stats:
+                return int(stats["bytes_limit"])
+        except Exception:
+            pass
+        return 0
+
+    @property
+    def memory_available_bytes(self) -> int:
+        try:
+            stats = self.jax_device.memory_stats()
+            if stats and "bytes_limit" in stats:
+                return int(stats["bytes_limit"]) - int(stats.get("bytes_in_use", 0))
+        except Exception:
+            pass
+        return 0
+
+    def copy(self) -> "Device":
+        return Device(self.jax_device, self.partition_cores)
+
+    def log_info(self) -> str:
+        mem = self.memory_bytes
+        mem_s = f"{mem / (1 << 30):.2f} GiB" if mem else "unknown"
+        return (
+            f"Device: {self.name} ({self.platform}), cores={self.compute_units}, "
+            f"mem={mem_s}, dedicated={self.dedicated_memory}"
+        )
+
+    def __repr__(self) -> str:  # pragma: no cover - cosmetic
+        return f"Device({self.name!r})"
+
+
+class Devices(Sequence[Device]):
+    """An ordered device selection (reference: ClDevices,
+    ClObjectApi.cs:781-1272).  All filters return new ``Devices`` with device
+    copies; ``+`` concatenates selections (ClObjectApi.cs:813-829)."""
+
+    def __init__(self, devices: Iterable[Device] = ()):  # noqa: D107
+        self._devices: list[Device] = [d for d in devices]
+
+    # -- Sequence protocol ---------------------------------------------------
+    def __len__(self) -> int:
+        return len(self._devices)
+
+    def __iter__(self) -> Iterator[Device]:
+        return iter(self._devices)
+
+    def __getitem__(self, idx):
+        if isinstance(idx, slice):
+            return Devices(d.copy() for d in self._devices[idx])
+        return self._devices[idx].copy()
+
+    def __add__(self, other: "Devices") -> "Devices":
+        seen: set[int] = set()
+        out: list[Device] = []
+        for d in list(self._devices) + list(other._devices):
+            key = id(d.jax_device)
+            if key not in seen:
+                seen.add(key)
+                out.append(d.copy())
+        return Devices(out)
+
+    def __repr__(self) -> str:  # pragma: no cover - cosmetic
+        return f"Devices([{', '.join(d.name for d in self._devices)}])"
+
+    # -- filters -------------------------------------------------------------
+    def _filtered(self, pred: Callable[[Device], bool]) -> "Devices":
+        return Devices(d.copy() for d in self._devices if pred(d))
+
+    def tpus(self) -> "Devices":
+        return self._filtered(lambda d: d.is_tpu)
+
+    # reference naming: gpus()/accelerators() select the accelerator class
+    def gpus(self) -> "Devices":
+        return self.tpus()
+
+    def accelerators(self) -> "Devices":
+        return self.tpus()
+
+    def cpus(self) -> "Devices":
+        return self._filtered(lambda d: d.is_cpu)
+
+    def with_dedicated_memory(self) -> "Devices":
+        """reference: devicesWithDedicatedMemory (ClObjectApi.cs:1118-1145)"""
+        return self._filtered(lambda d: d.dedicated_memory)
+
+    def with_host_memory_sharing(self) -> "Devices":
+        """reference: devicesWithHostMemorySharing (ClObjectApi.cs:1150-1193)"""
+        return self._filtered(lambda d: not d.dedicated_memory)
+
+    def with_most_compute_units(self) -> "Devices":
+        """reference: devicesWithMostComputeUnits (ClObjectApi.cs:1202-1212)"""
+        if not self._devices:
+            return Devices()
+        best = max(d.compute_units for d in self._devices)
+        return self._filtered(lambda d: d.compute_units == best)
+
+    def with_highest_memory_available(self) -> "Devices":
+        """reference: devicesWithHighestMemoryAvailable (ClObjectApi.cs:1150-1160)"""
+        if not self._devices:
+            return Devices()
+        ranked = sorted(
+            self._devices, key=lambda d: d.memory_available_bytes, reverse=True
+        )
+        return Devices(d.copy() for d in ranked)
+
+    def with_highest_nbody_performance(self, n: int = 2048, iters: int = 3) -> "Devices":
+        """Rank devices by a direct-nbody micro-benchmark, fastest first
+        (reference: devicesWithHighestDirectNbodyPerformance runs
+        ``Tester.nBody`` per device, ClObjectApi.cs:1222-1244)."""
+        from .ops import nbody  # local import: ops depends on hardware
+
+        timed = [(nbody.microbenchmark(d.jax_device, n=n, iters=iters), d) for d in self._devices]
+        timed.sort(key=lambda t: t[0])
+        return Devices(d.copy() for _, d in timed)
+
+    def subset(self, count: int) -> "Devices":
+        """First ``count`` devices (reference: numberOfGPUsToUse trimming)."""
+        return self[:count]
+
+    def jax_devices(self) -> list[jax.Device]:
+        return [d.jax_device for d in self._devices]
+
+    def log_info(self) -> str:
+        lines = [d.log_info() for d in self._devices]
+        text = "\n".join(lines) if lines else "(no devices)"
+        print(text)
+        return text
+
+    def require_nonempty(self, what: str = "selection") -> "Devices":
+        if not self._devices:
+            raise DeviceSelectionError(f"no devices matched {what}")
+        return self
+
+
+@dataclass(frozen=True)
+class Platform:
+    """A PJRT backend (reference: ClPlatform, ClPlatform.cs:31-206)."""
+
+    name: str
+    _devices: tuple = field(repr=False, default=())
+
+    @property
+    def vendor(self) -> str:
+        return "Google" if self.name in _ACCEL_BACKENDS else "host"
+
+    def devices(self) -> Devices:
+        return Devices(Device(d) for d in self._devices)
+
+    def num_tpus(self) -> int:
+        return len(self.devices().tpus())
+
+    def num_cpus(self) -> int:
+        return len(self.devices().cpus())
+
+    # reference naming
+    def num_gpus(self) -> int:
+        return self.num_tpus()
+
+    def num_accelerators(self) -> int:
+        return self.num_tpus()
+
+    def log_info(self) -> str:
+        return f"Platform: {self.name} (vendor={self.vendor}, devices={len(self._devices)})"
+
+
+class Platforms(Sequence[Platform]):
+    """All available backends (reference: ClPlatforms, ClObjectApi.cs:158-775)."""
+
+    def __init__(self, items: Iterable[Platform]):
+        self._items = list(items)
+
+    @staticmethod
+    def all() -> "Platforms":
+        """Enumerate every usable backend (reference: ClPlatforms.all(),
+        ClObjectApi.cs:204-216)."""
+        found: list[Platform] = []
+        for backend in ("tpu", "axon", "cuda", "rocm", "cpu"):
+            try:
+                devs = jax.devices(backend)
+            except Exception:
+                continue
+            if devs:
+                found.append(Platform(backend, tuple(devs)))
+        if not found:
+            found.append(Platform(jax.default_backend(), tuple(jax.devices())))
+        # dedupe by underlying device ids (tpu may alias axon)
+        seen: set[tuple] = set()
+        out = []
+        for p in found:
+            key = tuple(id(d) for d in p._devices)
+            if key not in seen:
+                seen.add(key)
+                out.append(p)
+        return Platforms(out)
+
+    def __len__(self) -> int:
+        return len(self._items)
+
+    def __iter__(self) -> Iterator[Platform]:
+        return iter(self._items)
+
+    def __getitem__(self, idx):
+        if isinstance(idx, slice):
+            return Platforms(self._items[idx])
+        return self._items[idx]
+
+    def with_most_devices(self) -> "Platforms":
+        """reference: platformsWithMostDevices (ClObjectApi.cs:268-279)"""
+        if not self._items:
+            return Platforms([])
+        best = max(len(p._devices) for p in self._items)
+        return Platforms([p for p in self._items if len(p._devices) == best])
+
+    def tpus(self) -> Devices:
+        out = Devices()
+        for p in self._items:
+            out = out + p.devices().tpus()
+        return out
+
+    def gpus(self) -> Devices:
+        return self.tpus()
+
+    def accelerators(self) -> Devices:
+        return self.tpus()
+
+    def cpus(self) -> Devices:
+        out = Devices()
+        for p in self._items:
+            out = out + p.devices().cpus()
+        return out
+
+    def devices(self) -> Devices:
+        out = Devices()
+        for p in self._items:
+            out = out + p.devices()
+        return out
+
+    def log_info(self) -> str:
+        text = "\n".join(p.log_info() for p in self._items)
+        print(text)
+        return text
+
+
+def platforms() -> Platforms:
+    """Convenience: ``platforms().tpus()`` etc."""
+    return Platforms.all()
+
+
+def all_devices() -> Devices:
+    return Platforms.all().devices()
+
+
+def devices_for_type(flags: AcceleratorType, max_devices: int = 0) -> Devices:
+    """Select devices by AcceleratorType flags (reference: Cores device
+    discovery per type, Cores.cs:156-273)."""
+    sel = Devices()
+    plats = Platforms.all()
+    for p in plats:
+        if _backend_matches(p.name, flags):
+            sel = sel + p.devices()
+    if flags & (AcceleratorType.TPU | AcceleratorType.GPU | AcceleratorType.ACC):
+        # accelerator-class request should not silently pick up host devices
+        sel_acc = sel.tpus()
+        if flags & AcceleratorType.CPU:
+            sel_acc = sel_acc + sel.cpus()
+        sel = sel_acc
+    if max_devices > 0:
+        sel = sel.subset(max_devices)
+    return sel.require_nonempty(f"AcceleratorType {flags!r}")

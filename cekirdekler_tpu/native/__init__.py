@@ -1,0 +1,3 @@
+from .build import available, load
+
+__all__ = ["available", "load"]

@@ -1,0 +1,107 @@
+"""Lazy builder + ctypes loader for the native host runtime library.
+
+The reference ships its native layer as a prebuilt DLL; we build ours from
+source on first use with the system toolchain and cache the shared object
+next to the source.  Thread-safe; failures degrade gracefully (callers fall
+back to pure-numpy host arrays).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from pathlib import Path
+
+_HERE = Path(__file__).resolve().parent
+_SRC = _HERE / "kutuphane_tpu.cpp"
+_LIB = _HERE / "libkutuphane_tpu.so"
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_load_failed = False
+
+
+def _compile() -> bool:
+    cmd = [
+        "g++",
+        "-O2",
+        "-shared",
+        "-fPIC",
+        "-std=c++17",
+        "-fvisibility=hidden",
+        str(_SRC),
+        "-o",
+        str(_LIB),
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return True
+    except Exception:
+        return False
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    i64 = ctypes.c_int64
+    p = ctypes.c_void_p
+    lib.ck_sizeOf.argtypes = [ctypes.c_int]
+    lib.ck_sizeOf.restype = ctypes.c_int
+    lib.ck_createArray.argtypes = [i64, i64]
+    lib.ck_createArray.restype = p
+    lib.ck_alignedArrHead.argtypes = [p, i64]
+    lib.ck_alignedArrHead.restype = p
+    lib.ck_deleteArray.argtypes = [p, i64, i64]
+    lib.ck_deleteArray.restype = None
+    lib.ck_copyMemory.argtypes = [p, p, i64]
+    lib.ck_copyMemory.restype = None
+    lib.ck_fillMemory.argtypes = [p, ctypes.c_int, i64]
+    lib.ck_fillMemory.restype = None
+    lib.ck_liveAllocations.argtypes = []
+    lib.ck_liveAllocations.restype = i64
+    lib.ck_liveBytes.argtypes = []
+    lib.ck_liveBytes.restype = i64
+    for name in (
+        "ck_createMarkerCounter",
+        "ck_abiVersion",
+    ):
+        getattr(lib, name).argtypes = []
+        getattr(lib, name).restype = i64
+    for name in ("ck_deleteMarkerCounter", "ck_addMarker", "ck_markerReached", "ck_resetMarkerCounter"):
+        getattr(lib, name).argtypes = [i64]
+        getattr(lib, name).restype = None
+    for name in ("ck_markersAdded", "ck_markersReached", "ck_markersRemaining"):
+        getattr(lib, name).argtypes = [i64]
+        getattr(lib, name).restype = i64
+    return lib
+
+
+def load() -> ctypes.CDLL | None:
+    """Load (building if needed) the native library; None if unavailable."""
+    global _lib, _load_failed
+    if _lib is not None:
+        return _lib
+    if _load_failed:
+        return None
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if _load_failed:
+            return None
+        try:
+            if not _LIB.exists() or _LIB.stat().st_mtime < _SRC.stat().st_mtime:
+                if not _compile():
+                    _load_failed = True
+                    return None
+            lib = ctypes.CDLL(str(_LIB))
+            if lib.ck_abiVersion() != 1:
+                raise OSError("ABI mismatch")
+            _lib = _bind(lib)
+            return _lib
+        except Exception:
+            _load_failed = True
+            return None
+
+
+def available() -> bool:
+    return load() is not None

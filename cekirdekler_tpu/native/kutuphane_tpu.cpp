@@ -1,0 +1,216 @@
+// kutuphane_tpu — native host-side runtime support for cekirdekler_tpu.
+//
+// TPU-native replacement for the capabilities the reference keeps in its
+// C++ KutuphaneCL.dll host-array layer (contract recovered from the P/Invoke
+// surface at CSpaceArrays.cs:108-147: sizeOf / createArray / alignedArrHead /
+// deleteArray / copyMemory) plus the command-queue marker counters
+// (ClCommandQueue.cs:99-115: addMarkerToCommandQueue /
+// getMarkerCounterOfCommandQueue / resetMarkerCounterOfCommandQueue).
+//
+// Provides:
+//   * page-aligned host allocations (4096 B like the reference) for
+//     fast, DMA-friendly host staging buffers ("FastArr" backing store),
+//   * bulk memcpy / fill helpers that release the Python GIL implicitly
+//     (plain C calls through ctypes),
+//   * atomic marker counters used for fine-grained progress observation by
+//     the pool scheduler and enqueue mode,
+//   * allocation statistics for leak tests.
+//
+// Exposed as flat C symbols consumed via ctypes (arrays/fastarr.py).
+
+#include <atomic>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <new>
+
+#if defined(_WIN32)
+#define EXPORT extern "C" __declspec(dllexport)
+#else
+#define EXPORT extern "C" __attribute__((visibility("default")))
+#endif
+
+namespace {
+
+constexpr std::size_t kDefaultAlignment = 4096;  // page/DMA alignment, matches reference
+
+std::atomic<std::int64_t> g_live_allocations{0};
+std::atomic<std::int64_t> g_live_bytes{0};
+
+struct MarkerCounter {
+  std::atomic<std::int64_t> added{0};
+  std::atomic<std::int64_t> reached{0};
+};
+
+std::mutex g_counter_mutex;
+std::map<std::int64_t, MarkerCounter*> g_counters;
+std::int64_t g_next_counter_id = 1;
+
+}  // namespace
+
+// ---------------------------------------------------------------------------
+// element sizes (reference: native `sizeOf`, type codes ARR_FLOAT..ARR_CHAR,
+// CSpaceArrays.cs:48-109)
+// ---------------------------------------------------------------------------
+
+// type codes — kept numerically identical to the reference's ARR_* constants
+// so serialized cluster traffic stays self-describing.
+enum TypeCode : int {
+  ARR_FLOAT = 0,
+  ARR_DOUBLE = 1,
+  ARR_INT = 2,
+  ARR_LONG = 3,
+  ARR_UINT = 4,
+  ARR_BYTE = 5,
+  ARR_CHAR = 6,
+  ARR_BFLOAT16 = 7,  // TPU-native addition
+  ARR_BOOL = 8,
+};
+
+EXPORT int ck_sizeOf(int type_code) {
+  switch (type_code) {
+    case ARR_FLOAT: return 4;
+    case ARR_DOUBLE: return 8;
+    case ARR_INT: return 4;
+    case ARR_LONG: return 8;
+    case ARR_UINT: return 4;
+    case ARR_BYTE: return 1;
+    case ARR_CHAR: return 2;  // reference char is UTF-16 (C#); kept for wire parity
+    case ARR_BFLOAT16: return 2;
+    case ARR_BOOL: return 1;
+    default: return -1;
+  }
+}
+
+// ---------------------------------------------------------------------------
+// aligned host allocations (reference: createArray / alignedArrHead /
+// deleteArray, CSpaceArrays.cs:119-147)
+// ---------------------------------------------------------------------------
+
+EXPORT void* ck_createArray(std::int64_t num_bytes, std::int64_t alignment) {
+  if (num_bytes <= 0) return nullptr;
+  std::size_t align =
+      alignment > 0 ? static_cast<std::size_t>(alignment) : kDefaultAlignment;
+  // round the size up so aligned_alloc's size-multiple-of-alignment rule holds
+  std::size_t size = static_cast<std::size_t>(num_bytes);
+  size = (size + align - 1) / align * align;
+  void* p = std::aligned_alloc(align, size);
+  if (p != nullptr) {
+    g_live_allocations.fetch_add(1, std::memory_order_relaxed);
+    g_live_bytes.fetch_add(static_cast<std::int64_t>(size),
+                           std::memory_order_relaxed);
+    // touch pages now so first DMA doesn't eat soft page faults
+    std::memset(p, 0, size);
+  }
+  return p;
+}
+
+// With aligned_alloc the head pointer IS the aligned pointer; kept as a
+// separate entry point for contract parity with the reference, where raw and
+// aligned heads differ (CSpaceArrays.cs:239-244).
+EXPORT void* ck_alignedArrHead(void* raw, std::int64_t alignment) {
+  (void)alignment;
+  return raw;
+}
+
+EXPORT void ck_deleteArray(void* raw, std::int64_t num_bytes,
+                           std::int64_t alignment) {
+  if (raw == nullptr) return;
+  std::size_t align =
+      alignment > 0 ? static_cast<std::size_t>(alignment) : kDefaultAlignment;
+  std::size_t size = static_cast<std::size_t>(num_bytes > 0 ? num_bytes : 0);
+  size = (size + align - 1) / align * align;
+  std::free(raw);
+  g_live_allocations.fetch_sub(1, std::memory_order_relaxed);
+  g_live_bytes.fetch_sub(static_cast<std::int64_t>(size),
+                         std::memory_order_relaxed);
+}
+
+EXPORT void ck_copyMemory(void* dst, const void* src, std::int64_t num_bytes) {
+  if (dst == nullptr || src == nullptr || num_bytes <= 0) return;
+  std::memcpy(dst, src, static_cast<std::size_t>(num_bytes));
+}
+
+EXPORT void ck_fillMemory(void* dst, int byte_value, std::int64_t num_bytes) {
+  if (dst == nullptr || num_bytes <= 0) return;
+  std::memset(dst, byte_value, static_cast<std::size_t>(num_bytes));
+}
+
+EXPORT std::int64_t ck_liveAllocations() {
+  return g_live_allocations.load(std::memory_order_relaxed);
+}
+
+EXPORT std::int64_t ck_liveBytes() {
+  return g_live_bytes.load(std::memory_order_relaxed);
+}
+
+// ---------------------------------------------------------------------------
+// marker counters (reference: addMarkerToCommandQueue +
+// getMarkerCounterOfCommandQueue + resetMarkerCounterOfCommandQueue,
+// ClCommandQueue.cs:39-47,99-115 — native callback counts completions)
+// ---------------------------------------------------------------------------
+
+EXPORT std::int64_t ck_createMarkerCounter() {
+  std::lock_guard<std::mutex> lock(g_counter_mutex);
+  std::int64_t id = g_next_counter_id++;
+  g_counters[id] = new MarkerCounter();
+  return id;
+}
+
+EXPORT void ck_deleteMarkerCounter(std::int64_t id) {
+  std::lock_guard<std::mutex> lock(g_counter_mutex);
+  auto it = g_counters.find(id);
+  if (it != g_counters.end()) {
+    delete it->second;
+    g_counters.erase(it);
+  }
+}
+
+namespace {
+MarkerCounter* find_counter(std::int64_t id) {
+  std::lock_guard<std::mutex> lock(g_counter_mutex);
+  auto it = g_counters.find(id);
+  return it == g_counters.end() ? nullptr : it->second;
+}
+}  // namespace
+
+EXPORT void ck_addMarker(std::int64_t id) {
+  if (MarkerCounter* c = find_counter(id)) {
+    c->added.fetch_add(1, std::memory_order_relaxed);
+  }
+}
+
+EXPORT void ck_markerReached(std::int64_t id) {
+  if (MarkerCounter* c = find_counter(id)) {
+    c->reached.fetch_add(1, std::memory_order_relaxed);
+  }
+}
+
+EXPORT std::int64_t ck_markersAdded(std::int64_t id) {
+  MarkerCounter* c = find_counter(id);
+  return c ? c->added.load(std::memory_order_relaxed) : -1;
+}
+
+EXPORT std::int64_t ck_markersReached(std::int64_t id) {
+  MarkerCounter* c = find_counter(id);
+  return c ? c->reached.load(std::memory_order_relaxed) : -1;
+}
+
+EXPORT std::int64_t ck_markersRemaining(std::int64_t id) {
+  MarkerCounter* c = find_counter(id);
+  if (c == nullptr) return -1;
+  return c->added.load(std::memory_order_relaxed) -
+         c->reached.load(std::memory_order_relaxed);
+}
+
+EXPORT void ck_resetMarkerCounter(std::int64_t id) {
+  if (MarkerCounter* c = find_counter(id)) {
+    c->added.store(0, std::memory_order_relaxed);
+    c->reached.store(0, std::memory_order_relaxed);
+  }
+}
+
+// ABI sanity probe for the ctypes loader.
+EXPORT std::int64_t ck_abiVersion() { return 1; }

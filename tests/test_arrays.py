@@ -1,0 +1,146 @@
+"""Host array layer tests (reference: Tester.buffers() per-type checks of
+ClArray/FastArr indexing, CopyFrom/CopyTo, C#<->native migration,
+Tester.cs:7076-7672)."""
+
+import numpy as np
+import pytest
+
+from cekirdekler_tpu.arrays import (
+    ByteArr,
+    ClArray,
+    DoubleArr,
+    FastArr,
+    FloatArr,
+    IntArr,
+    LongArr,
+    ParameterGroup,
+    UIntArr,
+    wrap,
+)
+from cekirdekler_tpu.arrays.fastarr import ALIGNMENT, type_code_for_dtype
+from cekirdekler_tpu.errors import ComputeValidationError
+from cekirdekler_tpu import native
+
+
+TYPED = [
+    (FloatArr, np.float32),
+    (DoubleArr, np.float64),
+    (IntArr, np.int32),
+    (UIntArr, np.uint32),
+    (LongArr, np.int64),
+    (ByteArr, np.uint8),
+]
+
+
+def test_native_library_builds():
+    # the native tier must actually build on this machine
+    assert native.available()
+
+
+@pytest.mark.parametrize("cls,dtype", TYPED)
+def test_fastarr_roundtrip(cls, dtype):
+    fa = cls(1000)
+    assert fa.dtype == np.dtype(dtype)
+    assert len(fa) == 1000
+    fa[0] = 7
+    fa[999] = 3
+    assert fa[0] == 7 and fa[999] == 3
+    src = np.arange(1000).astype(dtype)
+    fa.copy_from(src)
+    out = np.zeros(1000, dtype=dtype)
+    fa.copy_to(out)
+    np.testing.assert_array_equal(out, src)
+    np.testing.assert_array_equal(fa.to_array(), src)
+    fa.dispose()
+
+
+def test_fastarr_alignment():
+    fa = FloatArr(16)
+    assert fa.address() % ALIGNMENT == 0
+    fa.dispose()
+
+
+def test_fastarr_native_backing_and_leak_counter():
+    lib = native.load()
+    assert lib is not None
+    before = lib.ck_liveAllocations()
+    fa = FloatArr(4096)
+    assert fa.is_native
+    assert lib.ck_liveAllocations() == before + 1
+    fa.dispose()
+    assert lib.ck_liveAllocations() == before
+
+
+def test_type_codes_match_reference_layout():
+    assert type_code_for_dtype(np.float32) == 0
+    assert type_code_for_dtype(np.float64) == 1
+    assert type_code_for_dtype(np.int32) == 2
+    assert type_code_for_dtype(np.int64) == 3
+    assert type_code_for_dtype(np.uint32) == 4
+    assert type_code_for_dtype(np.uint8) == 5
+
+
+def test_clarray_auto_alloc_and_index():
+    a = ClArray(128, dtype=np.float32)
+    assert a.size == 128
+    a[5] = 2.5
+    assert a[5] == 2.5
+    assert not a.fast_arr
+
+
+def test_clarray_migration_numpy_native():
+    a = ClArray(64, dtype=np.int32)
+    a[:] = np.arange(64, dtype=np.int32)
+    a.fast_arr = True
+    assert a.fast_arr
+    np.testing.assert_array_equal(np.asarray(a), np.arange(64))
+    a[3] = -1
+    a.fast_arr = False
+    assert not a.fast_arr
+    assert a[3] == -1
+
+
+def test_clarray_resize_preserves():
+    a = ClArray(np.arange(10, dtype=np.float32))
+    a.resize(20)
+    assert a.size == 20
+    np.testing.assert_array_equal(np.asarray(a)[:10], np.arange(10))
+    a.resize(5)
+    np.testing.assert_array_equal(np.asarray(a), np.arange(5))
+
+
+def test_flag_mutual_exclusion():
+    a = ClArray(8)
+    a.read_only = True
+    assert not a.flags.write
+    a.write_only = True
+    assert not a.flags.read
+    with pytest.raises(ComputeValidationError):
+        a._set_flag(read_only=True, write_only=True)
+
+
+def test_read_write_string_parity():
+    a = ClArray(8)
+    a.partial_read = True
+    a.write_all = True
+    s = a.flags.read_write_string()
+    assert "partial" in s and "read" in s and "write" in s and "all" in s
+
+
+def test_parameter_group_chaining_order():
+    a = ClArray(8, name="a")
+    b = ClArray(8, name="b")
+    c = np.zeros(8, dtype=np.float32)
+    g = a.next_param(b).next_param(c)
+    assert isinstance(g, ParameterGroup)
+    names = [p.name for p in g.parameters()]
+    assert names[0] == "a" and names[1] == "b" and len(names) == 3
+
+
+def test_wrap_coercions():
+    assert isinstance(wrap([1.0, 2.0]), ClArray)
+    fa = FloatArr(4)
+    w = wrap(fa)
+    assert w.fast_arr
+    a = ClArray(4)
+    assert wrap(a) is a

@@ -1,0 +1,81 @@
+"""Hardware query DSL tests (reference behavior: ClObjectApi.cs selection
+semantics — copies on select, + concat dedupe, filters)."""
+
+import numpy as np
+import pytest
+
+from cekirdekler_tpu.hardware import (
+    AcceleratorType,
+    Devices,
+    Platforms,
+    all_devices,
+    devices_for_type,
+    platforms,
+)
+from cekirdekler_tpu.errors import DeviceSelectionError
+
+
+def test_platforms_enumerate():
+    plats = Platforms.all()
+    assert len(plats) >= 1
+    names = [p.name for p in plats]
+    assert "cpu" in names
+
+
+def test_cpu_devices_present_in_rig(cpu_devices):
+    devs = platforms().cpus()
+    assert len(devs) >= 8
+
+
+def test_indexing_returns_copies():
+    devs = platforms().cpus()
+    a = devs[0]
+    b = devs[0]
+    assert a is not b
+    assert a.jax_device is b.jax_device
+
+
+def test_concat_dedupes():
+    devs = platforms().cpus()
+    both = devs + devs
+    assert len(both) == len(devs)
+
+
+def test_subset_and_slice():
+    devs = platforms().cpus()
+    assert len(devs.subset(3)) == 3
+    assert len(devs[1:4]) == 3
+
+
+def test_filters():
+    devs = all_devices()
+    cpus = devs.cpus()
+    assert all(d.is_cpu for d in cpus)
+    shared = cpus.with_host_memory_sharing()
+    assert len(shared) == len(cpus)  # CPU devices share host memory
+    assert len(cpus.with_dedicated_memory()) == 0
+
+
+def test_with_most_compute_units_nonempty():
+    devs = platforms().cpus()
+    best = devs.with_most_compute_units()
+    assert len(best) >= 1
+
+
+def test_devices_for_type_cpu():
+    devs = devices_for_type(AcceleratorType.CPU)
+    assert len(devs) >= 8
+    devs2 = devices_for_type(AcceleratorType.CPU, max_devices=2)
+    assert len(devs2) == 2
+
+
+def test_devices_for_type_no_match_raises():
+    with pytest.raises(DeviceSelectionError):
+        Devices([]).require_nonempty("empty")
+
+
+def test_log_info_runs():
+    text = platforms().log_info()
+    assert "cpu" in text
+    dtext = platforms().cpus().subset(1).log_info()
+    assert "Device:" in dtext
