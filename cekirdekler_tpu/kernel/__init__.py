@@ -1,0 +1,10 @@
+from .lang import extract_kernel_names, parse_kernels
+from .registry import KernelProgram, PythonKernel, kernel
+
+__all__ = [
+    "KernelProgram",
+    "PythonKernel",
+    "extract_kernel_names",
+    "kernel",
+    "parse_kernels",
+]

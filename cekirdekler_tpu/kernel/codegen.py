@@ -1,0 +1,862 @@
+"""Kernel codegen: lower parsed kernel ASTs to vectorized JAX functions.
+
+Strategy (the TPU-first answer to the reference's per-work-item OpenCL
+execution model, SURVEY.md §7): instead of launching one scalar program per
+work item, we *vectorize over work items* — a launch chunk of ``B``
+consecutive work items becomes one array program where every scalar local
+variable is a ``(B,)`` vector and ``get_global_id(0)`` is
+``offset + iota(B)``.  This maps the kernel straight onto the TPU VPU/MXU
+and lets XLA fuse the whole body.
+
+Key mechanisms:
+
+- **Affine index tracking** — every integer value carries an optional
+  ``(stride, offset)`` annotation meaning ``value == stride*gid + offset``.
+  Loads/stores with stride-1 indices lower to
+  ``lax.dynamic_slice`` / ``lax.dynamic_update_slice`` (contiguous DMA-
+  friendly vector ops); anything else falls back to gather/scatter.
+- **Masked control flow** — ``if``/``else`` run both branches under
+  disjoint masks (stores become masked read-modify-writes, locals merge via
+  ``where``); an early ``return`` folds into a cumulative return-mask.
+  This is the standard SIMT→SIMD predication transform.
+- **Vectorized loops** — ``for``/``while`` lower to ``lax.while_loop`` with
+  a per-item active mask (loops run until *all* items are done — exactly the
+  mandelbrot iteration pattern); locals keep their declared C dtype so loop
+  carries are shape/dtype-stable and nothing recompiles when trip counts
+  change at runtime.
+
+The launch boundary: ``build_kernel_fn`` returns ``fn(offset, *buffers,
+value_args) -> updated buffers``, where ``offset`` is a *runtime* scalar —
+the load balancer can re-partition the global range every call without
+triggering recompilation (the reference's NDRange-offset semantics,
+Cores.cs:607-613, preserved under jit).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..errors import KernelCompileError, KernelLanguageError
+from . import lang
+from .lang import (
+    Assign,
+    BinOp,
+    Call,
+    Cast,
+    CrementStmt,
+    Decl,
+    For,
+    If,
+    Index,
+    KernelDef,
+    Num,
+    Return,
+    Ternary,
+    UnOp,
+    Var,
+    While,
+)
+
+__all__ = ["build_kernel_fn", "KernelBuildInfo", "ctype_to_dtype"]
+
+
+# ---------------------------------------------------------------------------
+# C type lattice
+# ---------------------------------------------------------------------------
+
+_INT_TYPES = {"char", "uchar", "short", "ushort", "int", "uint", "long", "ulong", "bool"}
+_FLOAT_TYPES = {"float", "double", "half"}
+_RANK = {
+    "bool": 0, "char": 1, "uchar": 1, "short": 2, "ushort": 2,
+    "int": 3, "uint": 4, "long": 5, "ulong": 6,
+    "half": 7, "float": 8, "double": 9,
+}
+
+
+def _x64_enabled() -> bool:
+    return bool(jax.config.read("jax_enable_x64"))
+
+
+def ctype_to_dtype(ctype: str):
+    """Map a C type to the jnp dtype actually used on this backend.  long and
+    double degrade to 32-bit when x64 is disabled (standard JAX behavior on
+    TPU; the CPU test rig enables x64 for full-width parity)."""
+    table = {
+        "bool": jnp.bool_,
+        "char": jnp.int8,
+        "uchar": jnp.uint8,
+        "short": jnp.int16,
+        "ushort": jnp.uint16,
+        "int": jnp.int32,
+        "uint": jnp.uint32,
+        "long": jnp.int64 if _x64_enabled() else jnp.int32,
+        "ulong": jnp.uint64 if _x64_enabled() else jnp.uint32,
+        "half": jnp.float16,
+        "float": jnp.float32,
+        "double": jnp.float64 if _x64_enabled() else jnp.float32,
+    }
+    if ctype not in table:
+        raise KernelLanguageError(f"unsupported type {ctype!r}")
+    return jnp.dtype(table[ctype])
+
+
+def _dtype_to_ctype(dtype) -> str:
+    name = jnp.dtype(dtype).name
+    table = {
+        "bool": "bool", "int8": "char", "uint8": "uchar", "int16": "short",
+        "uint16": "ushort", "int32": "int", "uint32": "uint", "int64": "long",
+        "uint64": "ulong", "float16": "half", "float32": "float",
+        "float64": "double", "bfloat16": "half",
+    }
+    return table.get(name, "float")
+
+
+def _promote(t1: str, t2: str) -> str:
+    """C usual arithmetic conversions (simplified to the rank lattice)."""
+    a, b = (t1, t2) if _RANK[t1] >= _RANK[t2] else (t2, t1)
+    if a in _FLOAT_TYPES:
+        return a
+    # integer promotion: everything below int promotes to int
+    if _RANK[a] < _RANK["int"]:
+        return "int"
+    return a
+
+
+@dataclass
+class KVal:
+    """A value in the vectorized program.
+
+    ``affine`` — when not None, ``(stride, const)`` with a *Python-int*
+    stride such that ``value == stride * gid + const`` elementwise (``gid``
+    being the global work-item id vector); ``const`` is a Python int or a
+    traced scalar.  Drives the contiguous slice fast path: stride-1 indices
+    with an int ``const`` lower to dynamic_slice/dynamic_update_slice over a
+    ``const``-padded buffer (padding makes tail chunks exact — a clamped
+    slice would silently shift the window).
+    """
+
+    value: Any
+    ctype: str
+    affine: Optional[tuple[int, Any]] = None
+
+    @property
+    def is_vector(self) -> bool:
+        return hasattr(self.value, "ndim") and self.value.ndim > 0
+
+
+class _Ctx:
+    """Interpretation context for one kernel launch chunk."""
+
+    def __init__(self, B: int, offset, global_size, local_size: int, ctx_info: dict):
+        self.B = B
+        self.offset = offset  # scalar int32 (traced)
+        self.env: dict[str, KVal] = {}
+        self.bufs: dict[str, Any] = {}
+        self.buf_ctypes: dict[str, str] = {}
+        self.stored: set[str] = set()
+        self.mask: Any = None  # None == all-active; else bool (B,)
+        self.return_mask: Any = None  # items that already returned
+        self.global_size = global_size
+        self.local_size = local_size
+        self.info = ctx_info
+        idx = jnp.arange(B, dtype=jnp.int32)
+        self.gid = KVal(offset + idx, "int", affine=(1, 0))
+        # padded-view cache for shifted slice loads: name -> {const: padded}
+        self._pad_cache: dict[str, dict[int, Any]] = {}
+
+    def padded_view(self, name: str, c: int):
+        """Buffer padded so the shifted window [offset+c, offset+c+B) is
+        always in bounds; returns (padded, left_pad)."""
+        cache = self._pad_cache.setdefault(name, {})
+        if c in cache:
+            return cache[c]
+        buf = self.bufs[name]
+        lo, hi = max(0, -c), max(0, c)
+        padded = jnp.pad(buf, (lo, hi))
+        cache[c] = (padded, lo)
+        return padded, lo
+
+    def invalidate_padded(self, name: str) -> None:
+        self._pad_cache.pop(name, None)
+
+    def active_mask(self):
+        """Combined current mask (branch mask minus returned items)."""
+        m = self.mask
+        if self.return_mask is not None:
+            rm = jnp.logical_not(self.return_mask)
+            m = rm if m is None else jnp.logical_and(m, rm)
+        return m
+
+
+# ---------------------------------------------------------------------------
+# expression evaluation
+# ---------------------------------------------------------------------------
+
+
+def _as_dtype(v: KVal, ctype: str) -> KVal:
+    if v.ctype == ctype:
+        return v
+    dt = ctype_to_dtype(ctype)
+    val = v.value
+    if hasattr(val, "astype"):
+        val = val.astype(dt)
+    else:
+        val = jnp.asarray(val, dtype=dt) if not isinstance(val, (int, float, bool)) else dt.type(val)
+    affine = v.affine if (v.ctype in _INT_TYPES and ctype in _INT_TYPES) else None
+    return KVal(val, ctype, affine)
+
+
+def _const_int(v: KVal) -> Optional[int]:
+    """Python-int view of a compile-time constant, else None."""
+    if v.affine is not None and v.affine[0] == 0 and isinstance(v.affine[1], int):
+        return v.affine[1]
+    if isinstance(v.value, int):
+        return v.value
+    return None
+
+
+def _eval(ctx: _Ctx, node) -> KVal:
+    if isinstance(node, Num):
+        return KVal(node.value, node.ctype, affine=(0, node.value) if node.ctype in _INT_TYPES else None)
+    if isinstance(node, Var):
+        if node.name in ctx.env:
+            return ctx.env[node.name]
+        raise KernelCompileError(f"undefined variable {node.name!r}", line=node.line)
+    if isinstance(node, Index):
+        return _load(ctx, node)
+    if isinstance(node, BinOp):
+        return _binop(ctx, node)
+    if isinstance(node, UnOp):
+        v = _eval(ctx, node.operand)
+        if node.op == "+":
+            return v
+        if node.op == "-":
+            aff = None
+            if v.affine is not None:
+                s, o = v.affine
+                aff = (-s, -o if isinstance(o, int) else -o)
+            return KVal(-_num(v), v.ctype if v.ctype in _FLOAT_TYPES else _promote(v.ctype, "int"), aff)
+        if node.op == "!":
+            return KVal(jnp.logical_not(_truthy(v)), "bool")
+        if node.op == "~":
+            return KVal(~_num(_as_dtype(v, _promote(v.ctype, "int"))), _promote(v.ctype, "int"))
+        raise KernelCompileError(f"unknown unary op {node.op}", line=node.line)
+    if isinstance(node, Ternary):
+        c = _truthy(_eval(ctx, node.cond))
+        a = _eval(ctx, node.then)
+        b = _eval(ctx, node.other)
+        t = _promote(a.ctype, b.ctype)
+        av, bv = _num(_as_dtype(a, t)), _num(_as_dtype(b, t))
+        return KVal(jnp.where(c, av, bv), t)
+    if isinstance(node, Cast):
+        return _as_dtype(_eval(ctx, node.operand), node.ctype)
+    if isinstance(node, Call):
+        return _call(ctx, node)
+    raise KernelCompileError(f"cannot evaluate node {type(node).__name__}", line=getattr(node, "line", 0))
+
+
+def _num(v: KVal):
+    """Raw numeric payload with the KVal's dtype materialized."""
+    val = v.value
+    if isinstance(val, (int, float, bool)):
+        return ctype_to_dtype(v.ctype).type(val)
+    return val
+
+
+def _truthy(v: KVal):
+    if v.ctype == "bool":
+        return v.value if hasattr(v.value, "dtype") else jnp.asarray(v.value, jnp.bool_)
+    return _num(v) != 0
+
+
+def _binop(ctx: _Ctx, node: BinOp) -> KVal:
+    op = node.op
+    if op in ("&&", "||"):
+        l = _truthy(_eval(ctx, node.left))
+        r = _truthy(_eval(ctx, node.right))
+        fn = jnp.logical_and if op == "&&" else jnp.logical_or
+        return KVal(fn(l, r), "bool")
+
+    a = _eval(ctx, node.left)
+    b = _eval(ctx, node.right)
+
+    if op in ("==", "!=", "<", ">", "<=", ">="):
+        t = _promote(a.ctype, b.ctype)
+        av, bv = _num(_as_dtype(a, t)), _num(_as_dtype(b, t))
+        fns = {
+            "==": jnp.equal, "!=": jnp.not_equal, "<": jnp.less,
+            ">": jnp.greater, "<=": jnp.less_equal, ">=": jnp.greater_equal,
+        }
+        return KVal(fns[op](av, bv), "bool")
+
+    t = _promote(a.ctype, b.ctype)
+    ac, bc = _as_dtype(a, t), _as_dtype(b, t)
+    av, bv = _num(ac), _num(bc)
+
+    affine = None
+    if t in _INT_TYPES:
+        ka, kb = ac.affine, bc.affine
+        ca, cb = _const_int(ac), _const_int(bc)
+        if op == "+" and ka is not None and kb is not None:
+            affine = (ka[0] + kb[0], _add_off(ka[1], kb[1]))
+        elif op == "-" and ka is not None and kb is not None:
+            affine = (ka[0] - kb[0], _sub_off(ka[1], kb[1]))
+        elif op == "*" and ka is not None and cb is not None:
+            affine = (ka[0] * cb, _mul_off(ka[1], cb))
+        elif op == "*" and kb is not None and ca is not None:
+            affine = (kb[0] * ca, _mul_off(kb[1], ca))
+
+    if op == "+":
+        return KVal(av + bv, t, affine)
+    if op == "-":
+        return KVal(av - bv, t, affine)
+    if op == "*":
+        return KVal(av * bv, t, affine)
+    if op == "/":
+        if t in _FLOAT_TYPES:
+            return KVal(av / bv, t)
+        return KVal(lax.div(jnp.asarray(av), jnp.asarray(bv)), t)  # C truncating division
+    if op == "%":
+        if t in _FLOAT_TYPES:
+            return KVal(jnp.fmod(av, bv), t)
+        return KVal(lax.rem(jnp.asarray(av), jnp.asarray(bv)), t)  # C remainder semantics
+    if op in ("&", "|", "^"):
+        it = t if t in _INT_TYPES else "int"
+        av, bv = _num(_as_dtype(ac, it)), _num(_as_dtype(bc, it))
+        fns = {"&": jnp.bitwise_and, "|": jnp.bitwise_or, "^": jnp.bitwise_xor}
+        return KVal(fns[op](av, bv), it)
+    if op in ("<<", ">>"):
+        it = t if t in _INT_TYPES else "int"
+        av = _num(_as_dtype(ac, it))
+        bv = _num(_as_dtype(bc, it))
+        fn = jnp.left_shift if op == "<<" else jnp.right_shift
+        return KVal(fn(av, bv), it)
+    raise KernelCompileError(f"unknown operator {op}", line=node.line)
+
+
+def _add_off(a, b):
+    return a + b
+
+
+def _sub_off(a, b):
+    return a - b
+
+
+def _mul_off(a, c):
+    return a * c
+
+
+# ---------------------------------------------------------------------------
+# builtins
+# ---------------------------------------------------------------------------
+
+_UNARY_FLOAT = {
+    "sqrt": jnp.sqrt, "rsqrt": lax.rsqrt, "cbrt": jnp.cbrt, "exp": jnp.exp,
+    "exp2": jnp.exp2, "exp10": lambda x: jnp.power(10.0, x), "log": jnp.log,
+    "log2": jnp.log2, "log10": jnp.log10, "sin": jnp.sin, "cos": jnp.cos,
+    "tan": jnp.tan, "asin": jnp.arcsin, "acos": jnp.arccos, "atan": jnp.arctan,
+    "sinh": jnp.sinh, "cosh": jnp.cosh, "tanh": jnp.tanh, "asinh": jnp.arcsinh,
+    "acosh": jnp.arccosh, "atanh": jnp.arctanh, "fabs": jnp.abs,
+    "floor": jnp.floor, "ceil": jnp.ceil, "round": jnp.round, "rint": jnp.round,
+    "trunc": jnp.trunc, "erf": lax.erf, "erfc": lax.erfc,
+    "degrees": jnp.degrees, "radians": jnp.radians, "sign": jnp.sign,
+}
+
+_BINARY_FLOAT = {
+    "pow": jnp.power, "powr": jnp.power, "atan2": jnp.arctan2,
+    "fmod": jnp.fmod, "remainder": jnp.remainder, "hypot": jnp.hypot,
+    "copysign": jnp.copysign, "fdim": lambda a, b: jnp.maximum(a - b, 0.0),
+    "nextafter": jnp.nextafter,
+}
+
+_UNSUPPORTED_CALLS = {
+    "barrier": "work-group barriers (no shared memory in the vectorized TPU contract; "
+               "use separate kernels — the reference's pipelines exist for exactly this)",
+    "mem_fence": "memory fences (XLA orders operations by data flow)",
+    "work_group_barrier": "work-group barriers",
+}
+
+
+def _call(ctx: _Ctx, node: Call) -> KVal:
+    name = node.name
+    if name.startswith("native_") or name.startswith("half_"):
+        name = name.split("_", 1)[1]
+    if name.startswith("atomic_") or name.startswith("atom_"):
+        raise KernelLanguageError(
+            f"{node.name}: atomics are not supported in the vectorized TPU contract; "
+            "express reductions as separate reduction kernels",
+            line=node.line,
+        )
+    if name in _UNSUPPORTED_CALLS:
+        raise KernelLanguageError(f"{name}: {_UNSUPPORTED_CALLS[name]}", line=node.line)
+
+    args = [_eval(ctx, a) for a in node.args]
+
+    if name in ("get_global_id", "get_local_id", "get_group_id", "get_global_size",
+                "get_local_size", "get_num_groups", "get_global_offset", "get_work_dim"):
+        dim = _const_int(args[0]) if args else 0
+        if name != "get_work_dim" and dim not in (0, None):
+            raise KernelLanguageError(
+                f"{name}({dim}): only dimension 0 is supported (the reference's "
+                "NDRange is 1-D, ClNdRange.cs:29-71)", line=node.line)
+        if name == "get_global_id":
+            return ctx.gid
+        if name == "get_global_size":
+            return KVal(ctx.global_size, "int", affine=(0, ctx.global_size) if isinstance(ctx.global_size, int) else None)
+        if name == "get_local_size":
+            return KVal(ctx.local_size, "int", affine=(0, ctx.local_size))
+        if name == "get_local_id":
+            g = _num(ctx.gid)
+            return KVal(lax.rem(g, jnp.int32(ctx.local_size)), "int")
+        if name == "get_group_id":
+            g = _num(ctx.gid)
+            return KVal(lax.div(g, jnp.int32(ctx.local_size)), "int")
+        if name == "get_num_groups":
+            gs = ctx.global_size
+            return KVal(gs // ctx.local_size if isinstance(gs, int) else lax.div(gs, ctx.local_size), "int")
+        if name == "get_global_offset":
+            return KVal(0, "int", affine=(0, 0))
+        return KVal(1, "int", affine=(0, 1))  # get_work_dim
+
+    if name in _UNARY_FLOAT:
+        a = args[0]
+        t = a.ctype if a.ctype in _FLOAT_TYPES else "float"
+        if name in ("fabs", "sign") and a.ctype in _INT_TYPES:
+            t = a.ctype
+            return KVal(jnp.abs(_num(a)) if name == "fabs" else jnp.sign(_num(a)), t)
+        return KVal(_UNARY_FLOAT[name](_num(_as_dtype(a, t))), t)
+
+    if name in _BINARY_FLOAT:
+        t = _promote(args[0].ctype, args[1].ctype)
+        if t not in _FLOAT_TYPES:
+            t = "float"
+        av, bv = _num(_as_dtype(args[0], t)), _num(_as_dtype(args[1], t))
+        return KVal(_BINARY_FLOAT[name](av, bv), t)
+
+    if name == "abs":
+        return KVal(jnp.abs(_num(args[0])), args[0].ctype)
+    if name in ("min", "fmin"):
+        t = _promote(args[0].ctype, args[1].ctype)
+        return KVal(jnp.minimum(_num(_as_dtype(args[0], t)), _num(_as_dtype(args[1], t))), t)
+    if name in ("max", "fmax"):
+        t = _promote(args[0].ctype, args[1].ctype)
+        return KVal(jnp.maximum(_num(_as_dtype(args[0], t)), _num(_as_dtype(args[1], t))), t)
+    if name == "clamp":
+        t = _promote(_promote(args[0].ctype, args[1].ctype), args[2].ctype)
+        x, lo, hi = (_num(_as_dtype(a, t)) for a in args)
+        return KVal(jnp.clip(x, lo, hi), t)
+    if name in ("mad", "fma"):
+        t = "float"
+        for a in args:
+            t = _promote(t, a.ctype) if a.ctype in _FLOAT_TYPES else t
+        a, b, c = (_num(_as_dtype(x, t)) for x in args)
+        return KVal(a * b + c, t)
+    if name == "mix":
+        t = "float"
+        a, b, w = (_num(_as_dtype(x, t)) for x in args)
+        return KVal(a + (b - a) * w, t)
+    if name == "step":
+        t = "float"
+        edge, x = (_num(_as_dtype(a, t)) for a in args)
+        return KVal(jnp.where(x < edge, 0.0, 1.0).astype(ctype_to_dtype(t)), t)
+    if name == "smoothstep":
+        t = "float"
+        e0, e1, x = (_num(_as_dtype(a, t)) for a in args)
+        u = jnp.clip((x - e0) / (e1 - e0), 0.0, 1.0)
+        return KVal(u * u * (3.0 - 2.0 * u), t)
+    if name == "select":
+        # OpenCL select(a, b, c) == c ? b : a
+        c = _truthy(args[2])
+        t = _promote(args[0].ctype, args[1].ctype)
+        return KVal(jnp.where(c, _num(_as_dtype(args[1], t)), _num(_as_dtype(args[0], t))), t)
+    if name == "isnan":
+        return KVal(jnp.isnan(_num(args[0])), "bool")
+    if name == "isinf":
+        return KVal(jnp.isinf(_num(args[0])), "bool")
+    if name == "isfinite":
+        return KVal(jnp.isfinite(_num(args[0])), "bool")
+
+    raise KernelLanguageError(f"unknown function {node.name!r}", line=node.line)
+
+
+# ---------------------------------------------------------------------------
+# loads / stores
+# ---------------------------------------------------------------------------
+
+
+def _load(ctx: _Ctx, node: Index) -> KVal:
+    if node.base not in ctx.bufs:
+        raise KernelCompileError(f"{node.base!r} is not an array parameter", line=node.line)
+    buf = ctx.bufs[node.base]
+    ctype = ctx.buf_ctypes[node.base]
+    idx = _eval(ctx, node.index)
+    if idx.ctype not in _INT_TYPES:
+        raise KernelLanguageError("array index must be an integer", line=node.line)
+    if idx.affine is not None and idx.affine[0] == 1 and isinstance(idx.affine[1], int):
+        c = idx.affine[1]
+        if c == 0:
+            start = jnp.asarray(ctx.offset, jnp.int32)
+            return KVal(lax.dynamic_slice(buf, (start,), (ctx.B,)), ctype)
+        padded, lo = ctx.padded_view(node.base, c)
+        start = jnp.asarray(ctx.offset + c + lo, jnp.int32)
+        return KVal(lax.dynamic_slice(padded, (start,), (ctx.B,)), ctype)
+    iv = _num(_as_dtype(idx, "int"))
+    if not hasattr(iv, "ndim") or iv.ndim == 0:
+        iv = jnp.full((ctx.B,), iv, dtype=jnp.int32)
+    return KVal(jnp.take(buf, iv, mode="clip"), ctype)
+
+
+def _store(ctx: _Ctx, node: Index, val: KVal) -> None:
+    if node.base not in ctx.bufs:
+        raise KernelCompileError(f"{node.base!r} is not an array parameter", line=node.line)
+    buf = ctx.bufs[node.base]
+    ctype = ctx.buf_ctypes[node.base]
+    v = _num(_as_dtype(val, ctype))
+    if not hasattr(v, "ndim") or v.ndim == 0:
+        v = jnp.full((ctx.B,), v, dtype=ctype_to_dtype(ctype))
+    idx = _eval(ctx, node.index)
+    m = ctx.active_mask()
+    if (idx.affine is not None and idx.affine[0] == 1
+            and isinstance(idx.affine[1], int) and m is None):
+        c = idx.affine[1]
+        if c == 0:
+            start = jnp.asarray(ctx.offset, jnp.int32)
+            ctx.bufs[node.base] = lax.dynamic_update_slice(buf, v, (start,))
+        else:
+            n = buf.shape[0]
+            lo, hi = max(0, -c), max(0, c)
+            padded = jnp.pad(buf, (lo, hi))
+            start = jnp.asarray(ctx.offset + c + lo, jnp.int32)
+            updated = lax.dynamic_update_slice(padded, v, (start,))
+            ctx.bufs[node.base] = lax.slice(updated, (lo,), (lo + n,))
+        ctx.invalidate_padded(node.base)
+    else:
+        iv = _num(_as_dtype(idx, "int"))
+        if not hasattr(iv, "ndim") or iv.ndim == 0:
+            iv = jnp.full((ctx.B,), iv, dtype=jnp.int32)
+        if m is not None:
+            # redirect masked-off lanes out of bounds and drop them — a
+            # read-modify-write would race with active lanes hitting the
+            # same index (duplicate-index scatter order is unspecified)
+            iv = jnp.where(m, iv, jnp.int32(buf.shape[0]))
+        ctx.bufs[node.base] = buf.at[iv].set(v, mode="drop")
+        ctx.invalidate_padded(node.base)
+    ctx.stored.add(node.base)
+
+
+# ---------------------------------------------------------------------------
+# statements
+# ---------------------------------------------------------------------------
+
+
+def _exec_block(ctx: _Ctx, stmts: list) -> None:
+    for s in stmts:
+        _exec(ctx, s)
+
+
+def _exec(ctx: _Ctx, node) -> None:
+    if isinstance(node, Decl):
+        for name, init in node.names:
+            if init is not None:
+                v = _as_dtype(_eval(ctx, init), node.ctype)
+            else:
+                v = KVal(ctype_to_dtype(node.ctype).type(0), node.ctype,
+                         affine=(0, 0) if node.ctype in _INT_TYPES else None)
+            ctx.env[name] = v
+        return
+    if isinstance(node, Assign):
+        if node.target is None:  # bare call statement
+            _eval(ctx, node.value)
+            return
+        _assign(ctx, node.target, node.op, node.value)
+        return
+    if isinstance(node, CrementStmt):
+        one = Num(value=1, ctype="int", line=node.line)
+        _assign(ctx, node.target, "+=" if node.op == "++" else "-=", one)
+        return
+    if isinstance(node, If):
+        _exec_if(ctx, node)
+        return
+    if isinstance(node, (For, While)):
+        _exec_loop(ctx, node)
+        return
+    if isinstance(node, Return):
+        m = ctx.active_mask()
+        if m is None:
+            m = jnp.ones((ctx.B,), jnp.bool_)
+        ctx.return_mask = m if ctx.return_mask is None else jnp.logical_or(ctx.return_mask, m)
+        return
+    raise KernelCompileError(f"cannot execute node {type(node).__name__}", line=getattr(node, "line", 0))
+
+
+def _assign(ctx: _Ctx, target, op: str, value_expr) -> None:
+    rhs = _eval(ctx, value_expr)
+    if op != "=":
+        base_op = op[:-1]
+        cur = _eval(ctx, target)
+        rhs = _binop(ctx, BinOp(op=base_op, left=_Lit(cur), right=_Lit(rhs), line=getattr(target, "line", 0)))
+    if isinstance(target, Var):
+        name = target.name
+        if name in ctx.env:
+            old = ctx.env[name]
+            new = _as_dtype(rhs, old.ctype)  # assignment keeps the declared C type
+            m = ctx.active_mask()
+            if m is not None:
+                ov, nv = _num(old), _num(new)
+                merged = jnp.where(m, nv, ov)
+                new = KVal(merged, old.ctype, None)
+            ctx.env[name] = new
+        else:
+            raise KernelCompileError(f"assignment to undeclared variable {name!r}",
+                                     line=getattr(target, "line", 0))
+        return
+    if isinstance(target, Index):
+        _store(ctx, target, rhs)
+        return
+    raise KernelCompileError("invalid assignment target", line=getattr(target, "line", 0))
+
+
+class _Lit:
+    """Wrap an already-evaluated KVal so it can re-enter _eval."""
+
+    def __init__(self, v: KVal):
+        self.v = v
+        self.line = 0
+
+
+_orig_eval = _eval
+
+
+def _eval(ctx: _Ctx, node) -> KVal:  # noqa: F811 - deliberate wrapper
+    if isinstance(node, _Lit):
+        return node.v
+    return _orig_eval(ctx, node)
+
+
+def _exec_if(ctx: _Ctx, node: If) -> None:
+    cond = _truthy(_eval(ctx, node.cond))
+    is_const_true = isinstance(node.cond, Num) and node.cond.value == 1
+    if is_const_true and not node.other:
+        _exec_block(ctx, node.then)  # bare { } block
+        return
+
+    outer_mask = ctx.mask
+    cvec = jnp.broadcast_to(cond, (ctx.B,)) if (not hasattr(cond, "ndim") or cond.ndim == 0) else cond
+
+    # early-return pattern: if (cond) return;
+    then_mask = cvec if outer_mask is None else jnp.logical_and(outer_mask, cvec)
+    else_mask = jnp.logical_not(cvec) if outer_mask is None else jnp.logical_and(outer_mask, jnp.logical_not(cvec))
+
+    ctx.mask = then_mask
+    _exec_block(ctx, node.then)
+    if node.other:
+        ctx.mask = else_mask
+        _exec_block(ctx, node.other)
+    ctx.mask = outer_mask
+
+
+def _exec_loop(ctx: _Ctx, node) -> None:
+    """Lower for/while to a vectorized lax.while_loop with a per-item active
+    mask (see module docstring)."""
+    if isinstance(node, For):
+        if node.init is not None:
+            _exec(ctx, node.init)
+        cond_expr = node.cond if node.cond is not None else Num(value=1, ctype="int", line=node.line)
+        body = list(node.body) + ([node.step] if node.step is not None else [])
+    else:
+        cond_expr = node.cond
+        body = list(node.body)
+
+    carried_vars = sorted(_assigned_vars(body) & set(ctx.env.keys()))
+    carried_bufs = sorted(_stored_bufs(body) & set(ctx.bufs.keys()))
+
+    outer_mask = ctx.active_mask()
+
+    # broadcast carried locals to (B,) so loop-carry shapes are stable
+    for name in carried_vars:
+        v = ctx.env[name]
+        val = _num(v)
+        if not hasattr(val, "ndim") or val.ndim == 0:
+            val = jnp.full((ctx.B,), val, dtype=ctype_to_dtype(v.ctype))
+        ctx.env[name] = KVal(val, v.ctype, None)
+
+    var_ctypes = {k: ctx.env[k].ctype for k in carried_vars}
+
+    def eval_cond(env, bufs):
+        saved_env, saved_bufs, saved_mask = ctx.env, ctx.bufs, ctx.mask
+        ctx.env = dict(saved_env)
+        ctx.env.update({k: KVal(v, var_ctypes[k], None) for k, v in env.items()})
+        ctx.bufs = dict(saved_bufs)
+        ctx.bufs.update(bufs)
+        c = _truthy(_eval(ctx, cond_expr))
+        ctx.env, ctx.bufs, ctx.mask = saved_env, saved_bufs, saved_mask
+        if not hasattr(c, "ndim") or c.ndim == 0:
+            c = jnp.broadcast_to(c, (ctx.B,))
+        return c
+
+    init_env = {k: ctx.env[k].value for k in carried_vars}
+    init_bufs = {k: ctx.bufs[k] for k in carried_bufs}
+    active0 = eval_cond(init_env, init_bufs)
+    if outer_mask is not None:
+        active0 = jnp.logical_and(active0, outer_mask)
+
+    def cond_fun(carry):
+        active, _, _ = carry
+        return jnp.any(active)
+
+    def body_fun(carry):
+        active, env_vals, buf_vals = carry
+        saved_env, saved_bufs, saved_mask = dict(ctx.env), dict(ctx.bufs), ctx.mask
+        saved_stored = set(ctx.stored)
+        saved_rm = ctx.return_mask
+        ctx.info["in_loop"] = ctx.info.get("in_loop", 0) + 1
+        try:
+            for k in carried_vars:
+                ctx.env[k] = KVal(env_vals[k], var_ctypes[k], None)
+            for k in carried_bufs:
+                ctx.bufs[k] = buf_vals[k]
+            ctx._pad_cache.clear()  # buffers swapped to loop tracers
+            ctx.mask = active
+            ctx.return_mask = None
+            env_keys_before = set(ctx.env.keys())
+            _exec_block(ctx, body)
+            if ctx.return_mask is not None:
+                raise KernelLanguageError(
+                    "'return' inside a loop is not supported; use the loop condition",
+                    line=getattr(node, "line", 0),
+                )
+            new_env = {k: _num(ctx.env[k]) for k in carried_vars}
+            new_bufs = {k: ctx.bufs[k] for k in carried_bufs}
+            # drop loop-local declarations so carry structure stays stable
+            for k in set(ctx.env.keys()) - env_keys_before:
+                del ctx.env[k]
+            new_active = jnp.logical_and(active, eval_cond(new_env, new_bufs))
+            return (new_active, new_env, new_bufs)
+        finally:
+            ctx.info["in_loop"] -= 1
+            ctx.env, ctx.bufs, ctx.mask = saved_env, saved_bufs, saved_mask
+            ctx.stored = saved_stored | ctx.stored
+            ctx.return_mask = saved_rm
+
+    active_f, env_f, bufs_f = lax.while_loop(cond_fun, body_fun, (active0, init_env, init_bufs))
+    ctx._pad_cache.clear()
+    for k in carried_vars:
+        ctx.env[k] = KVal(env_f[k], var_ctypes[k], None)
+    for k in carried_bufs:
+        ctx.bufs[k] = bufs_f[k]
+        ctx.stored.add(k)
+
+
+def _assigned_vars(stmts: list) -> set[str]:
+    out: set[str] = set()
+
+    def walk(s):
+        if isinstance(s, Decl):
+            out.update(n for n, _ in s.names)
+        elif isinstance(s, Assign) and isinstance(s.target, Var):
+            out.add(s.target.name)
+        elif isinstance(s, CrementStmt) and isinstance(s.target, Var):
+            out.add(s.target.name)
+        elif isinstance(s, If):
+            for x in s.then:
+                walk(x)
+            for x in s.other:
+                walk(x)
+        elif isinstance(s, For):
+            if s.init is not None:
+                walk(s.init)
+            if s.step is not None:
+                walk(s.step)
+            for x in s.body:
+                walk(x)
+        elif isinstance(s, While):
+            for x in s.body:
+                walk(x)
+
+    for s in stmts:
+        walk(s)
+    return out
+
+
+def _stored_bufs(stmts: list) -> set[str]:
+    out: set[str] = set()
+
+    def walk(s):
+        if isinstance(s, (Assign, CrementStmt)) and isinstance(getattr(s, "target", None), Index):
+            out.add(s.target.base)
+        if isinstance(s, If):
+            for x in s.then + s.other:
+                walk(x)
+        elif isinstance(s, For):
+            if s.init is not None:
+                walk(s.init)
+            if s.step is not None:
+                walk(s.step)
+            for x in s.body:
+                walk(x)
+        elif isinstance(s, While):
+            for x in s.body:
+                walk(x)
+
+    for s in stmts:
+        walk(s)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# kernel function construction
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class KernelBuildInfo:
+    """Static description of one compiled kernel function."""
+
+    name: str
+    array_params: list[str]
+    value_params: list[str]
+    array_ctypes: dict[str, str]
+    stored_params: list[str]  # params the kernel writes (discovered at trace)
+
+
+def build_kernel_fn(
+    kernel: KernelDef,
+    chunk: int,
+    local_size: int,
+    global_size: int,
+) -> tuple[Callable, KernelBuildInfo]:
+    """Build the vectorized launch function for one kernel.
+
+    Returns ``(fn, info)`` where ``fn(offset, arrays_tuple, values_tuple)``
+    processes work items ``[offset, offset+chunk)`` and returns the tuple of
+    updated arrays (all array params, in declaration order).  ``offset`` is a
+    runtime scalar — re-balancing never recompiles.  ``chunk`` is static.
+    """
+    array_params = [p for p in kernel.params if p.is_pointer]
+    value_params = [p for p in kernel.params if not p.is_pointer]
+    info = KernelBuildInfo(
+        name=kernel.name,
+        array_params=[p.name for p in array_params],
+        value_params=[p.name for p in value_params],
+        array_ctypes={p.name: p.ctype for p in array_params},
+        stored_params=[],
+    )
+
+    def fn(offset, arrays: tuple, values: tuple = ()):
+        ctx = _Ctx(chunk, jnp.asarray(offset, jnp.int32), global_size, local_size, {})
+        for p, arr in zip(array_params, arrays):
+            ctx.bufs[p.name] = arr
+            ctx.buf_ctypes[p.name] = p.ctype
+        for p, v in zip(value_params, values):
+            ctx.env[p.name] = KVal(jnp.asarray(v, ctype_to_dtype(p.ctype)), p.ctype)
+        _exec_block(ctx, kernel.body)
+        info.stored_params = [n for n in info.array_params if n in ctx.stored]
+        return tuple(ctx.bufs[p.name] for p in array_params)
+
+    return fn, info

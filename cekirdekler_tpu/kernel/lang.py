@@ -1,0 +1,673 @@
+"""Kernel language front end: lexer + parser for the OpenCL-C-like subset.
+
+The reference accepts raw OpenCL-C kernel strings and hands them to the GPU
+driver compiler (ClProgram.cs:62-73; kernel names are regex-extracted at
+ClNumberCruncher.cs:219-228).  TPUs cannot execute C, so we define the
+*supported kernel contract* (SURVEY.md §7 "kernel-language surface"): a
+C-like subset — ``__kernel void name(__global float* a, ...)`` functions with
+scalar locals, arithmetic, comparisons, ``if``/``for``/``while``, and the
+common math builtins — which the codegen (codegen.py) vectorizes over work
+items and lowers to JAX/XLA.  Unsupported constructs (local memory, barriers,
+atomics, vector types, pointers beyond parameters) raise
+:class:`KernelLanguageError` with the offending line.
+
+This module is the front end only: source → list of :class:`KernelDef` ASTs.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from ..errors import KernelCompileError, KernelLanguageError
+
+__all__ = ["tokenize", "parse_kernels", "KernelDef", "Param", "extract_kernel_names"]
+
+# ---------------------------------------------------------------------------
+# lexer
+# ---------------------------------------------------------------------------
+
+KEYWORDS = {
+    "if", "else", "for", "while", "do", "return", "break", "continue",
+    "int", "uint", "long", "ulong", "float", "double", "half", "bool",
+    "char", "uchar", "short", "ushort", "void", "const", "unsigned",
+    "__kernel", "kernel", "__global", "global", "__local", "local",
+    "__constant", "constant", "__private", "private", "restrict", "volatile",
+    "size_t", "true", "false",
+}
+
+_TOKEN_RE = re.compile(
+    r"""
+    (?P<ws>\s+)
+  | (?P<comment>//[^\n]*|/\*.*?\*/)
+  | (?P<num>
+        0[xX][0-9a-fA-F]+[uUlL]*
+      | (?:\d+\.\d*|\.\d+|\d+)(?:[eE][+-]?\d+)?[fFuUlL]*
+    )
+  | (?P<id>[A-Za-z_][A-Za-z0-9_]*)
+  | (?P<op><<=|>>=|<<|>>|<=|>=|==|!=|&&|\|\||\+\+|--|\+=|-=|\*=|/=|%=|&=|\|=|\^=|->|[-+*/%<>=!&|^~?:.,;(){}\[\]])
+    """,
+    re.VERBOSE | re.DOTALL,
+)
+
+
+@dataclass(frozen=True)
+class Token:
+    kind: str  # 'num' | 'id' | 'kw' | 'op' | 'eof'
+    text: str
+    line: int
+
+
+def _strip_preprocessor(source: str) -> tuple[str, dict[str, str]]:
+    """Handle the tiny preprocessor surface kernels actually use:
+    parameterless ``#define NAME value`` substitution; other directives are
+    dropped with a warning-free ignore (``#pragma``) or rejected."""
+    defines: dict[str, str] = {}
+    out_lines: list[str] = []
+    for lineno, line in enumerate(source.splitlines(), 1):
+        stripped = line.strip()
+        if stripped.startswith("#"):
+            m = re.match(r"#\s*define\s+(\w+)(?:\s+(.*))?$", stripped)
+            if m:
+                if "(" in m.group(1):
+                    raise KernelLanguageError(
+                        "function-like macros are not supported", line=lineno
+                    )
+                defines[m.group(1)] = (m.group(2) or "").strip()
+                out_lines.append("")  # keep line numbers stable
+                continue
+            if re.match(r"#\s*(pragma|include|ifdef|ifndef|endif|if|else|undef)", stripped):
+                out_lines.append("")
+                continue
+            raise KernelLanguageError(f"unsupported preprocessor directive: {stripped}", line=lineno)
+        out_lines.append(line)
+    text = "\n".join(out_lines)
+    # iterative substitution (defines may reference earlier defines)
+    for _ in range(8):
+        changed = False
+        for name, val in defines.items():
+            new = re.sub(rf"\b{re.escape(name)}\b", val, text)
+            if new != text:
+                text, changed = new, True
+        if not changed:
+            break
+    return text, defines
+
+
+def tokenize(source: str) -> list[Token]:
+    text, _ = _strip_preprocessor(source)
+    tokens: list[Token] = []
+    pos = 0
+    line = 1
+    n = len(text)
+    while pos < n:
+        m = _TOKEN_RE.match(text, pos)
+        if m is None:
+            raise KernelCompileError(
+                f"unexpected character {text[pos]!r}", source=source, line=line
+            )
+        kind = m.lastgroup
+        tok_text = m.group()
+        if kind in ("ws", "comment"):
+            line += tok_text.count("\n")
+        elif kind == "id" and tok_text in KEYWORDS:
+            tokens.append(Token("kw", tok_text, line))
+        else:
+            tokens.append(Token(kind, tok_text, line))  # type: ignore[arg-type]
+        pos = m.end()
+    tokens.append(Token("eof", "", line))
+    return tokens
+
+
+# ---------------------------------------------------------------------------
+# AST
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class Node:
+    line: int = field(default=0, kw_only=True)
+
+
+# expressions
+@dataclass
+class Num(Node):
+    value: float | int
+    ctype: str  # 'int' | 'uint' | 'long' | 'float' | 'double'
+
+
+@dataclass
+class Var(Node):
+    name: str
+
+
+@dataclass
+class BinOp(Node):
+    op: str
+    left: Any
+    right: Any
+
+
+@dataclass
+class UnOp(Node):
+    op: str  # '-', '!', '~', '+'
+    operand: Any
+
+
+@dataclass
+class Ternary(Node):
+    cond: Any
+    then: Any
+    other: Any
+
+
+@dataclass
+class Call(Node):
+    name: str
+    args: list
+
+
+@dataclass
+class Index(Node):
+    base: str
+    index: Any
+
+
+@dataclass
+class Cast(Node):
+    ctype: str
+    operand: Any
+
+
+# statements
+@dataclass
+class Decl(Node):
+    ctype: str
+    names: list[tuple[str, Any | None]]  # (name, init-expr or None)
+
+
+@dataclass
+class Assign(Node):
+    target: Any  # Var or Index
+    op: str  # '=', '+=', '-=', '*=', '/=', '%=', '&=', '|=', '^=', '<<=', '>>='
+    value: Any
+
+
+@dataclass
+class CrementStmt(Node):
+    target: Any  # Var or Index
+    op: str  # '++' or '--'
+
+
+@dataclass
+class If(Node):
+    cond: Any
+    then: list
+    other: list
+
+
+@dataclass
+class For(Node):
+    init: Any | None  # Decl or Assign
+    cond: Any | None
+    step: Any | None  # Assign or CrementStmt
+    body: list
+
+
+@dataclass
+class While(Node):
+    cond: Any
+    body: list
+
+
+@dataclass
+class Return(Node):
+    pass
+
+
+@dataclass
+class Param(Node):
+    ctype: str        # element type for pointers, value type otherwise
+    name: str
+    is_pointer: bool = True
+    address_space: str = "global"  # 'global' | 'constant' | 'value'
+    is_const: bool = False
+
+
+@dataclass
+class KernelDef(Node):
+    name: str
+    params: list[Param] = field(default_factory=list)
+    body: list = field(default_factory=list)
+    source: str = ""
+
+
+# ---------------------------------------------------------------------------
+# parser (recursive descent)
+# ---------------------------------------------------------------------------
+
+_TYPE_KWS = {
+    "int", "uint", "long", "ulong", "float", "double", "half", "bool",
+    "char", "uchar", "short", "ushort", "size_t", "void", "unsigned",
+}
+
+_ASSIGN_OPS = {"=", "+=", "-=", "*=", "/=", "%=", "&=", "|=", "^=", "<<=", ">>="}
+
+
+class _Parser:
+    def __init__(self, tokens: list[Token], source: str):
+        self.toks = tokens
+        self.i = 0
+        self.source = source
+
+    # -- token helpers ------------------------------------------------------
+    @property
+    def cur(self) -> Token:
+        return self.toks[self.i]
+
+    def peek(self, k: int = 1) -> Token:
+        j = min(self.i + k, len(self.toks) - 1)
+        return self.toks[j]
+
+    def advance(self) -> Token:
+        t = self.cur
+        if t.kind != "eof":
+            self.i += 1
+        return t
+
+    def expect(self, text: str) -> Token:
+        t = self.cur
+        if t.text != text:
+            raise KernelCompileError(
+                f"expected {text!r}, found {t.text!r}", source=self.source, line=t.line
+            )
+        return self.advance()
+
+    def accept(self, text: str) -> bool:
+        if self.cur.text == text:
+            self.advance()
+            return True
+        return False
+
+    def err(self, msg: str, line: int | None = None) -> KernelCompileError:
+        return KernelCompileError(msg, source=self.source, line=line or self.cur.line)
+
+    # -- types --------------------------------------------------------------
+    def at_type(self) -> bool:
+        return self.cur.kind == "kw" and self.cur.text in _TYPE_KWS
+
+    def parse_type(self) -> str:
+        parts = []
+        while self.cur.kind == "kw" and self.cur.text in (_TYPE_KWS | {"const"}):
+            if self.cur.text != "const":
+                parts.append(self.cur.text)
+            self.advance()
+        if not parts:
+            raise self.err("expected a type")
+        t = " ".join(parts)
+        norm = {
+            "unsigned int": "uint", "unsigned long": "ulong", "unsigned char": "uchar",
+            "unsigned short": "ushort", "unsigned": "uint", "size_t": "long",
+        }
+        return norm.get(t, t)
+
+    # -- top level ----------------------------------------------------------
+    def parse_program(self) -> list[KernelDef]:
+        kernels: list[KernelDef] = []
+        while self.cur.kind != "eof":
+            start = self.cur
+            is_kernel = False
+            while self.cur.kind == "kw" and self.cur.text in ("__kernel", "kernel"):
+                is_kernel = True
+                self.advance()
+            if not is_kernel:
+                # non-kernel helper functions are not yet supported; skip
+                # top-level junk until we find a kernel or eof
+                raise self.err(
+                    f"only __kernel functions are supported at top level "
+                    f"(found {start.text!r}); helper functions must be inlined",
+                    line=start.line,
+                )
+            ret = self.parse_type()
+            if ret != "void":
+                raise KernelLanguageError(
+                    f"kernels must return void, not {ret}", line=start.line
+                )
+            name_tok = self.advance()
+            if name_tok.kind != "id":
+                raise self.err(f"expected kernel name, found {name_tok.text!r}", name_tok.line)
+            params = self.parse_params()
+            self.expect("{")
+            body = self.parse_block_items()
+            self.expect("}")
+            kernels.append(
+                KernelDef(name=name_tok.text, params=params, body=body,
+                          source=self.source, line=start.line)
+            )
+        if not kernels:
+            raise self.err("no __kernel functions found in source")
+        return kernels
+
+    def parse_params(self) -> list[Param]:
+        self.expect("(")
+        params: list[Param] = []
+        if self.accept(")"):
+            return params
+        while True:
+            line = self.cur.line
+            space = "value"
+            is_const = False
+            while self.cur.kind == "kw" and self.cur.text in (
+                "__global", "global", "__constant", "constant", "__local", "local",
+                "__private", "private", "const", "restrict", "volatile",
+            ):
+                t = self.advance().text
+                if t in ("__global", "global"):
+                    space = "global"
+                elif t in ("__constant", "constant"):
+                    space = "constant"
+                elif t in ("__local", "local"):
+                    raise KernelLanguageError(
+                        "__local memory parameters are not supported on TPU "
+                        "(no work-group shared memory in the vectorized contract)",
+                        line=line,
+                    )
+                elif t == "const":
+                    is_const = True
+            ctype = self.parse_type()
+            is_pointer = self.accept("*")
+            while self.cur.kind == "kw" and self.cur.text in ("const", "restrict", "volatile"):
+                self.advance()
+            name_tok = self.advance()
+            if name_tok.kind != "id":
+                raise self.err(f"expected parameter name, found {name_tok.text!r}", name_tok.line)
+            if is_pointer and space == "value":
+                space = "global"
+            params.append(
+                Param(ctype=ctype, name=name_tok.text, is_pointer=is_pointer,
+                      address_space=space if is_pointer else "value",
+                      is_const=is_const, line=line)
+            )
+            if self.accept(")"):
+                return params
+            self.expect(",")
+
+    # -- statements ---------------------------------------------------------
+    def parse_block_items(self) -> list:
+        items = []
+        while self.cur.text != "}" and self.cur.kind != "eof":
+            items.append(self.parse_statement())
+        return items
+
+    def parse_statement(self):
+        t = self.cur
+        if t.text == "{":
+            self.advance()
+            body = self.parse_block_items()
+            self.expect("}")
+            return If(cond=Num(value=1, ctype="int", line=t.line), then=body, other=[], line=t.line)
+        if t.kind == "kw":
+            if t.text == "if":
+                return self.parse_if()
+            if t.text == "for":
+                return self.parse_for()
+            if t.text == "while":
+                return self.parse_while()
+            if t.text == "do":
+                raise KernelLanguageError("do/while is not supported; use while", line=t.line)
+            if t.text == "return":
+                self.advance()
+                if not self.accept(";"):
+                    raise KernelLanguageError("kernels are void; 'return value;' unsupported", line=t.line)
+                return Return(line=t.line)
+            if t.text == "break" or t.text == "continue":
+                raise KernelLanguageError(
+                    f"'{t.text}' is not supported in the vectorized kernel contract; "
+                    "restructure with the loop condition or an if-guard",
+                    line=t.line,
+                )
+            if t.text in _TYPE_KWS or t.text == "const":
+                return self.parse_decl()
+        stmt = self.parse_expr_statement()
+        self.expect(";")
+        return stmt
+
+    def parse_decl(self) -> Decl:
+        line = self.cur.line
+        while self.accept("const"):
+            pass
+        ctype = self.parse_type()
+        if self.cur.text == "*":
+            raise KernelLanguageError("local pointer variables are not supported", line=line)
+        names: list[tuple[str, Any | None]] = []
+        while True:
+            name_tok = self.advance()
+            if name_tok.kind != "id":
+                raise self.err(f"expected variable name, found {name_tok.text!r}", name_tok.line)
+            init = None
+            if self.accept("="):
+                init = self.parse_expr()
+            names.append((name_tok.text, init))
+            if self.accept(";"):
+                break
+            self.expect(",")
+        return Decl(ctype=ctype, names=names, line=line)
+
+    def parse_expr_statement(self):
+        """assignment / compound assignment / ++ / -- / bare call"""
+        line = self.cur.line
+        lhs = self.parse_unary_postfixless()
+        t = self.cur.text
+        if t in _ASSIGN_OPS:
+            self.advance()
+            value = self.parse_expr()
+            if not isinstance(lhs, (Var, Index)):
+                raise self.err("invalid assignment target", line)
+            return Assign(target=lhs, op=t, value=value, line=line)
+        if t in ("++", "--"):
+            self.advance()
+            if not isinstance(lhs, (Var, Index)):
+                raise self.err("invalid ++/-- target", line)
+            return CrementStmt(target=lhs, op=t, line=line)
+        # bare expression statement (e.g. a call) — only calls are meaningful
+        if isinstance(lhs, Call):
+            return Assign(target=None, op="expr", value=lhs, line=line)
+        raise self.err(f"expression statement has no effect (near {t!r})", line)
+
+    def parse_if(self) -> If:
+        line = self.expect("if").line
+        self.expect("(")
+        cond = self.parse_expr()
+        self.expect(")")
+        then = self._stmt_as_block()
+        other: list = []
+        if self.accept("else"):
+            other = self._stmt_as_block()
+        return If(cond=cond, then=then, other=other, line=line)
+
+    def _stmt_as_block(self) -> list:
+        if self.accept("{"):
+            body = self.parse_block_items()
+            self.expect("}")
+            return body
+        return [self.parse_statement()]
+
+    def parse_for(self) -> For:
+        line = self.expect("for").line
+        self.expect("(")
+        init = None
+        if not self.accept(";"):
+            if self.at_type() or self.cur.text == "const":
+                init = self.parse_decl()  # consumes ';'
+            else:
+                init = self.parse_expr_statement()
+                self.expect(";")
+        cond = None
+        if not self.accept(";"):
+            cond = self.parse_expr()
+            self.expect(";")
+        step = None
+        if self.cur.text != ")":
+            step = self.parse_expr_statement()
+        self.expect(")")
+        body = self._stmt_as_block()
+        return For(init=init, cond=cond, step=step, body=body, line=line)
+
+    def parse_while(self) -> While:
+        line = self.expect("while").line
+        self.expect("(")
+        cond = self.parse_expr()
+        self.expect(")")
+        body = self._stmt_as_block()
+        return While(cond=cond, body=body, line=line)
+
+    # -- expressions (precedence climbing) ----------------------------------
+    def parse_expr(self):
+        return self.parse_ternary()
+
+    def parse_ternary(self):
+        cond = self.parse_binary(0)
+        if self.accept("?"):
+            then = self.parse_expr()
+            self.expect(":")
+            other = self.parse_ternary()
+            return Ternary(cond=cond, then=then, other=other, line=cond.line)
+        return cond
+
+    _PREC = [
+        ("||",),
+        ("&&",),
+        ("|",),
+        ("^",),
+        ("&",),
+        ("==", "!="),
+        ("<", ">", "<=", ">="),
+        ("<<", ">>"),
+        ("+", "-"),
+        ("*", "/", "%"),
+    ]
+
+    def parse_binary(self, level: int):
+        if level >= len(self._PREC):
+            return self.parse_unary()
+        lhs = self.parse_binary(level + 1)
+        while self.cur.text in self._PREC[level] and self.cur.kind == "op":
+            op = self.advance().text
+            rhs = self.parse_binary(level + 1)
+            lhs = BinOp(op=op, left=lhs, right=rhs, line=lhs.line)
+        return lhs
+
+    def parse_unary(self):
+        t = self.cur
+        if t.text in ("-", "!", "~", "+") and t.kind == "op":
+            self.advance()
+            return UnOp(op=t.text, operand=self.parse_unary(), line=t.line)
+        if t.text in ("++", "--"):
+            raise KernelLanguageError(
+                "prefix ++/-- in expressions is not supported; use a statement", line=t.line
+            )
+        if t.text == "(" and self.peek().kind == "kw" and self.peek().text in _TYPE_KWS:
+            # cast
+            self.advance()
+            ctype = self.parse_type()
+            self.expect(")")
+            return Cast(ctype=ctype, operand=self.parse_unary(), line=t.line)
+        return self.parse_postfix()
+
+    def parse_unary_postfixless(self):
+        """like parse_unary but used at statement heads (no cast ambiguity)"""
+        return self.parse_unary()
+
+    def parse_postfix(self):
+        expr = self.parse_primary()
+        while True:
+            t = self.cur
+            if t.text == "[":
+                self.advance()
+                idx = self.parse_expr()
+                self.expect("]")
+                if not isinstance(expr, Var):
+                    raise KernelLanguageError(
+                        "only direct parameter arrays can be indexed", line=t.line
+                    )
+                expr = Index(base=expr.name, index=idx, line=t.line)
+            elif t.text in ("++", "--"):
+                # postfix on expression position — only valid as a statement;
+                # leave for parse_expr_statement by stopping here
+                break
+            elif t.text == ".":
+                raise KernelLanguageError(
+                    "struct/vector member access is not supported", line=t.line
+                )
+            else:
+                break
+        return expr
+
+    def parse_primary(self):
+        t = self.cur
+        if t.kind == "num":
+            self.advance()
+            return _parse_num(t)
+        if t.kind == "kw" and t.text in ("true", "false"):
+            self.advance()
+            return Num(value=1 if t.text == "true" else 0, ctype="int", line=t.line)
+        if t.kind == "id":
+            name = self.advance().text
+            if self.cur.text == "(":
+                self.advance()
+                args = []
+                if not self.accept(")"):
+                    while True:
+                        args.append(self.parse_expr())
+                        if self.accept(")"):
+                            break
+                        self.expect(",")
+                return Call(name=name, args=args, line=t.line)
+            return Var(name=name, line=t.line)
+        if t.text == "(":
+            self.advance()
+            e = self.parse_expr()
+            self.expect(")")
+            return e
+        raise self.err(f"unexpected token {t.text!r}")
+
+
+def _parse_num(t: Token) -> Num:
+    s = t.text
+    suffix = ""
+    while s and s[-1] in "fFuUlL":
+        suffix += s[-1].lower()
+        s = s[:-1]
+    if s.startswith(("0x", "0X")):
+        val: float | int = int(s, 16)
+        ctype = "long" if "l" in suffix else ("uint" if "u" in suffix else "int")
+    elif "." in s or "e" in s or "E" in s:
+        val = float(s)
+        ctype = "float" if "f" in suffix else "double"
+    else:
+        val = int(s)
+        if "f" in suffix:
+            val = float(val)
+            ctype = "float"
+        else:
+            ctype = "long" if "l" in suffix else ("uint" if "u" in suffix else "int")
+    return Num(value=val, ctype=ctype, line=t.line)
+
+
+# ---------------------------------------------------------------------------
+# public API
+# ---------------------------------------------------------------------------
+
+
+def parse_kernels(source: str) -> list[KernelDef]:
+    """Parse a kernel source string into kernel ASTs."""
+    return _Parser(tokenize(source), source).parse_program()
+
+
+_KERNEL_NAME_RE = re.compile(r"(?:__kernel|kernel)\s+void\s+([A-Za-z_][A-Za-z0-9_]*)")
+
+
+def extract_kernel_names(source: str) -> list[str]:
+    """Fast regex name extraction (reference: ClNumberCruncher.cs:219-228)."""
+    return _KERNEL_NAME_RE.findall(source)

@@ -1,0 +1,172 @@
+"""Kernel program registry: parse once, JIT per launch geometry, cache.
+
+Mirrors the reference's compile pipeline — ``ClProgram`` builds the source
+per device and ``ClKernel``/``kernelWithId`` clone kernel objects per
+(name, computeId) so the same kernel can run concurrently with different
+arguments (Worker.cs:263-316).  Here, parsing happens once per source
+string; the vectorized launch function is built and jitted once per
+(kernel name, chunk size, local size, global size) and XLA's own cache
+handles distinct buffer shapes/dtypes.  The balancer changing per-chip
+ranges only changes the runtime ``offset`` argument — no recompilation
+(chunk sizes are bucketed by the scheduler, core/cores.py).
+
+Also provides the ``@kernel`` decorator path: a user Python function
+``f(gid, *arrays, **values)`` written directly in JAX — the escape hatch for
+kernels outside the C-subset contract (and the idiomatic TPU path; raw
+Pallas kernels plug in the same way via ops/).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..errors import KernelCompileError
+from . import codegen, lang
+
+__all__ = ["KernelProgram", "kernel", "PythonKernel"]
+
+
+@dataclass
+class PythonKernel:
+    """A kernel authored as a Python/JAX function.
+
+    The function receives ``gid`` (an int32 vector of global work-item ids
+    for the launch chunk) and the full array arguments, and returns the
+    updated arrays (tuple, same order).  Value arguments arrive as keyword
+    scalars.
+    """
+
+    fn: Callable
+    name: str
+    array_params: list[str]
+    value_params: list[str] = field(default_factory=list)
+
+
+def kernel(fn: Callable | None = None, *, name: str | None = None):
+    """Decorator: register a Python/JAX function as a kernel.
+
+    >>> @kernel
+    ... def scale(gid, a, factor=2.0):
+    ...     return a.at[gid].mul(factor)
+    """
+
+    def deco(f: Callable) -> PythonKernel:
+        import inspect
+
+        sig = inspect.signature(f)
+        params = list(sig.parameters.values())
+        if not params or params[0].name != "gid":
+            raise KernelCompileError(
+                f"@kernel function {f.__name__!r} must take 'gid' as its first parameter"
+            )
+        arrays = [p.name for p in params[1:] if p.default is inspect.Parameter.empty]
+        values = [p.name for p in params[1:] if p.default is not inspect.Parameter.empty]
+        return PythonKernel(fn=f, name=name or f.__name__, array_params=arrays, value_params=values)
+
+    return deco(fn) if fn is not None else deco
+
+
+class KernelProgram:
+    """A compiled kernel source: name → AST, plus the launch-function cache.
+
+    Accepts a C-subset source string, a :class:`PythonKernel`, or a mixed
+    sequence of both (reference: one kernel string holds many ``__kernel``
+    functions; names regex-extracted at ClNumberCruncher.cs:219-228).
+    """
+
+    def __init__(self, source: str | PythonKernel | Sequence):
+        self.source = source if isinstance(source, str) else ""
+        self._c_kernels: dict[str, lang.KernelDef] = {}
+        self._py_kernels: dict[str, PythonKernel] = {}
+        self._cache: dict[tuple, tuple[Callable, Any]] = {}
+        self._lock = threading.Lock()
+
+        items: list = []
+        if isinstance(source, (str, PythonKernel)):
+            items = [source]
+        else:
+            items = list(source)
+        for item in items:
+            if isinstance(item, str):
+                for kdef in lang.parse_kernels(item):
+                    self._c_kernels[kdef.name] = kdef
+            elif isinstance(item, PythonKernel):
+                self._py_kernels[item.name] = item
+            else:
+                raise KernelCompileError(f"unsupported kernel source: {type(item).__name__}")
+        if not self._c_kernels and not self._py_kernels:
+            raise KernelCompileError("no kernels found in source")
+
+    @property
+    def kernel_names(self) -> list[str]:
+        return list(self._c_kernels.keys()) + list(self._py_kernels.keys())
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._c_kernels or name in self._py_kernels
+
+    def array_param_count(self, name: str) -> int:
+        if name in self._c_kernels:
+            return sum(1 for p in self._c_kernels[name].params if p.is_pointer)
+        return len(self._py_kernels[name].array_params)
+
+    def value_param_names(self, name: str) -> list[str]:
+        if name in self._c_kernels:
+            return [p.name for p in self._c_kernels[name].params if not p.is_pointer]
+        return list(self._py_kernels[name].value_params)
+
+    def launcher(
+        self,
+        name: str,
+        chunk: int,
+        local_size: int,
+        global_size: int,
+    ) -> tuple[Callable, Any]:
+        """Get (building if needed) the jitted launch function for one
+        geometry.  Signature: ``fn(offset, arrays_tuple, values_tuple) ->
+        updated arrays tuple``."""
+        key = (name, chunk, local_size, global_size)
+        with self._lock:
+            hit = self._cache.get(key)
+        if hit is not None:
+            return hit
+
+        if name in self._c_kernels:
+            raw_fn, info = codegen.build_kernel_fn(
+                self._c_kernels[name], chunk, local_size, global_size
+            )
+        elif name in self._py_kernels:
+            pk = self._py_kernels[name]
+
+            def raw_fn(offset, arrays: tuple, values: tuple = (), _pk=pk):
+                gid = jnp.asarray(offset, jnp.int32) + jnp.arange(chunk, dtype=jnp.int32)
+                kw = dict(zip(_pk.value_params, values))
+                out = _pk.fn(gid, *arrays, **kw)
+                if not isinstance(out, tuple):
+                    out = (out,)
+                if len(out) != len(arrays):
+                    # python kernels may return only the modified arrays;
+                    # pad by identity on the left-over inputs
+                    out = tuple(out) + tuple(arrays[len(out):])
+                return out
+
+            info = codegen.KernelBuildInfo(
+                name=name,
+                array_params=list(pk.array_params),
+                value_params=list(pk.value_params),
+                array_ctypes={},
+                stored_params=list(pk.array_params),
+            )
+        else:
+            raise KernelCompileError(
+                f"kernel {name!r} not found; available: {self.kernel_names}"
+            )
+
+        jitted = jax.jit(raw_fn)
+        with self._lock:
+            self._cache[key] = (jitted, info)
+        return jitted, info
