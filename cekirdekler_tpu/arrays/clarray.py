@@ -107,6 +107,7 @@ class _ComputeMixin:
         pipeline: bool = False,
         pipeline_blobs: int = 4,
         pipeline_type: int | None = None,
+        values: Sequence | dict = (),
     ):
         """Run kernel(s) over ``global_range`` work items across all selected
         chips (reference: ClParameterGroup.compute → Cores.compute,
@@ -114,7 +115,9 @@ class _ComputeMixin:
 
         ``kernels`` may be a single name, a space-separated list
         ("k1 k2 k3" runs them in sequence, reference: kernel name lists),
-        or a sequence of names.
+        or a sequence of names.  ``values`` supplies scalar (non-pointer)
+        kernel arguments — a tuple applied to every kernel, or a dict
+        ``{kernel_name: tuple}``.
         """
         from ..core.cores import PIPELINE_EVENT  # local: core imports arrays
 
@@ -134,6 +137,7 @@ class _ComputeMixin:
             pipeline_blobs=pipeline_blobs,
             pipeline_type=pipeline_type,
             cruncher=cruncher,
+            value_args=values,
         )
 
     def task(

@@ -1,0 +1,16 @@
+from .balance import BalanceHistory, equal_split, load_balance
+from .cores import PIPELINE_DRIVER, PIPELINE_EVENT, ComputePerf, Cores
+from .cruncher import NumberCruncher
+from .worker import Worker
+
+__all__ = [
+    "BalanceHistory",
+    "ComputePerf",
+    "Cores",
+    "NumberCruncher",
+    "PIPELINE_DRIVER",
+    "PIPELINE_EVENT",
+    "Worker",
+    "equal_split",
+    "load_balance",
+]

@@ -1,0 +1,138 @@
+"""The iterative load balancer — re-partition the global range across chips
+from measured per-chip wall times.
+
+TPU-native re-implementation of the reference's ``Functions.loadBalance``
+(HelperFunctions.cs:190-280) with its history smoothing (:119-156):
+
+1. throughput_i ∝ (Σbench / bench_i) · (range_i + 1)   — work per unit time
+2. normalize throughputs to shares
+3. optional smoothing: shares averaged over a sliding history window
+   (depth 10, set at Cores.cs:1065) to damp noisy timings
+4. damped move:  range_i ← range_i − (range_i − total·share_i) · 0.3
+5. quantize each range to a multiple of ``step`` (round to nearest)
+6. repair the sum: add/remove one ``step`` at a time on the
+   largest-throughput (grow) / largest-range (shrink) element until
+   Σranges == total
+
+``step`` is the work-group granularity — ``local_range`` (or
+``local_range × pipeline_blobs`` when pipelined, matching
+Cores.cs:595-604).  On TPU we additionally align ``step`` to the lane tile
+when the caller asks (SURVEY.md §7: step = 8·128 multiples).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+__all__ = ["load_balance", "BalanceHistory", "equal_split", "DAMPING", "HISTORY_DEPTH"]
+
+DAMPING = 0.3        # reference: HelperFunctions.cs:246
+HISTORY_DEPTH = 10   # reference: Cores.cs:1065
+
+
+@dataclass
+class BalanceHistory:
+    """Sliding-window share smoothing (reference: HelperFunctions.cs:119-156)."""
+
+    depth: int = HISTORY_DEPTH
+    rows: list[list[float]] = field(default_factory=list)
+
+    def smooth(self, shares: list[float]) -> list[float]:
+        if self.rows and len(self.rows[0]) != len(shares):
+            self.rows.clear()  # device count changed
+        self.rows.append(list(shares))
+        if len(self.rows) > self.depth:
+            self.rows.pop(0)
+        n = len(shares)
+        out = [0.0] * n
+        for row in self.rows:
+            for i in range(n):
+                out[i] += row[i]
+        cnt = len(self.rows)
+        return [v / cnt for v in out]
+
+
+def equal_split(total: int, num: int, step: int) -> list[int]:
+    """First-call equal distribution in step quanta (reference:
+    Cores.cs:569-596)."""
+    if step <= 0:
+        raise ValueError("step must be positive")
+    if total % step != 0:
+        raise ValueError(f"total range {total} not divisible by step {step}")
+    units = total // step
+    base = units // num
+    rem = units - base * num
+    ranges = [(base + (1 if i < rem else 0)) * step for i in range(num)]
+    return ranges
+
+
+def load_balance(
+    benchmarks: list[float],
+    ranges: list[int],
+    total: int,
+    step: int,
+    history: BalanceHistory | None = None,
+    damping: float = DAMPING,
+    carry: list[float] | None = None,
+) -> list[int]:
+    """One balancer iteration; returns new per-chip ranges summing to
+    ``total``, each a multiple of ``step`` (≥ 0).
+
+    ``carry`` — optional mutable list holding the *continuous* (unquantized)
+    ranges across iterations.  The reference damps then quantizes in one
+    array, so any damped move smaller than step/2 rounds back and the
+    balancer stalls up to ~2 steps from the ideal split; carrying the
+    continuous state lets sub-step moves accumulate and converge exactly.
+    """
+    n = len(ranges)
+    if n == 1:
+        return [total]
+    if sum(ranges) != total:
+        ranges = equal_split(total, n, step)
+        if carry is not None:
+            carry.clear()
+
+    base: list[float]
+    if carry:
+        base = list(carry)
+    else:
+        base = [float(r) for r in ranges]
+
+    # 1-2: normalized throughput shares (measured on the quantized ranges)
+    safe = [max(b, 1e-9) for b in benchmarks]
+    tot_b = sum(safe)
+    thr = [(tot_b / safe[i]) * (ranges[i] + 1.0) for i in range(n)]
+    tot_t = sum(thr)
+    shares = [t / tot_t for t in thr]
+
+    # 3: optional smoothing
+    if history is not None:
+        shares = history.smooth(shares)
+        s = sum(shares)
+        shares = [v / s for v in shares]
+
+    # 4: damped continuous update
+    cont = [base[i] - (base[i] - total * shares[i]) * damping for i in range(n)]
+    if carry is not None:
+        carry[:] = cont
+
+    # 5: quantize to step, round to nearest
+    quant = [max(0, int((c / step) + 0.5)) * step for c in cont]
+
+    # 6: repair the sum one step at a time (reference: HelperFunctions.cs:271-279)
+    diff = total - sum(quant)
+    guard = 0
+    while diff != 0 and guard < 1_000_000:
+        guard += 1
+        if diff > 0:
+            # grant a step to the fastest (highest share) chip
+            i = max(range(n), key=lambda k: shares[k])
+            quant[i] += step
+            diff -= step
+        else:
+            # take a step from the largest allocation that can give one
+            candidates = [k for k in range(n) if quant[k] >= step]
+            i = max(candidates, key=lambda k: quant[k])
+            quant[i] -= step
+            diff += step
+    return quant

@@ -1,0 +1,377 @@
+"""Cores — the multi-chip scheduler: split / compute / join with iterative
+load balancing.
+
+TPU-native analogue of the reference's ``Cores`` (Cores.cs): owns one
+:class:`Worker` per chip (Cores.cs:56,260-262), the per-compute-id
+``global_ranges``/``global_references`` tables (Cores.cs:130-135), and the
+``compute()`` orchestration entry (Cores.cs:471-963) — first call splits the
+global range equally (Cores.cs:569-596), every later call re-partitions from
+measured per-chip times via :func:`core.balance.load_balance`
+(HelperFunctions.cs:190-280 port), then dispatches
+H2D → launch → D2H per chip concurrently (the reference's
+``Parallel.For`` phases, Cores.cs:746-835, become a thread pool over async
+XLA dispatch).
+
+Pipelined modes (reference: event pipeline Cores.cs:1236-1367 / driver
+pipeline :1371-1858): the chip's range is cut into ``pipeline_blobs``
+sub-ranges and blob k+1's H2D is issued while blob k computes — XLA async
+dispatch plays the role of the 16 command queues; D2H copies start per blob
+(``copy_to_host_async``) and are joined at the end.
+
+Enqueue mode (reference: ClNumberCruncher.cs:125-129, Cores.cs:836-949):
+skip host synchronization and readbacks entirely — data stays in HBM across
+repeated computes until :meth:`flush` is called.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass, field
+from typing import Any, Sequence
+
+from ..arrays.clarray import ClArray
+from ..errors import ComputeValidationError
+from ..hardware import Devices
+from ..kernel.registry import KernelProgram
+from .balance import BalanceHistory, equal_split, load_balance
+from .worker import Worker
+
+__all__ = ["Cores", "PIPELINE_EVENT", "PIPELINE_DRIVER", "ComputePerf"]
+
+PIPELINE_EVENT = 1   # reference: Cores.cs:416-423
+PIPELINE_DRIVER = 2
+
+
+@dataclass
+class ComputePerf:
+    """Per-compute-id performance record (reference: performanceReport,
+    Cores.cs:994-1063)."""
+
+    compute_id: int
+    device_ms: list[float] = field(default_factory=list)
+    device_items: list[int] = field(default_factory=list)
+    total_ms: float = 0.0
+
+    def report(self, device_names: list[str]) -> str:
+        lines = [f"compute id {self.compute_id}: total {self.total_ms:.3f} ms"]
+        tot = sum(self.device_items) or 1
+        for name, ms, it in zip(device_names, self.device_ms, self.device_items):
+            lines.append(
+                f"  {name}: {ms:8.3f} ms  {it:>10} workitems  load {100.0 * it / tot:5.1f}%"
+            )
+        text = "\n".join(lines)
+        return text
+
+
+class Cores:
+    """Scheduler over the selected chips."""
+
+    def __init__(self, devices: Devices, program: KernelProgram):
+        devices.require_nonempty("Cores device selection")
+        self.devices = devices
+        self.program = program
+        self.workers = [Worker(d.jax_device, i) for i, d in enumerate(devices)]
+        self.pool = ThreadPoolExecutor(max_workers=max(1, len(self.workers)))
+        # per-compute-id state (reference: Cores.cs:130-135)
+        self.global_ranges: dict[int, list[int]] = {}
+        self.global_references: dict[int, list[int]] = {}
+        self.histories: dict[int, BalanceHistory] = {}
+        self._cont_ranges: dict[int, list[float]] = {}  # continuous balancer state
+        self.perf: dict[int, ComputePerf] = {}
+        self.performance_feed = False
+        self.smooth_load_balancer = True
+        self.fixed_compute_powers: list[float] | None = None  # normalizedComputePowersOfDevices
+        self.repeat_count = 1
+        self.repeat_sync_kernel: str | None = None
+        self.enqueue_mode = False
+        self.no_compute_mode = False  # I/O only (reference: noComputeMode)
+        self._enqueued: list[tuple[Worker, ClArray, int, int, bool]] = []
+        self._lock = threading.Lock()
+        self.last_compute_id: int | None = None
+
+    @property
+    def num_devices(self) -> int:
+        return len(self.workers)
+
+    def device_names(self) -> list[str]:
+        return [d.name for d in self.devices]
+
+    # -- range tables --------------------------------------------------------
+    def _ranges_for(
+        self, compute_id: int, total: int, step: int, rebalance: bool
+    ) -> tuple[list[int], list[int]]:
+        n = self.num_devices
+        ranges = self.global_ranges.get(compute_id)
+        if ranges is None or sum(ranges) != total or len(ranges) != n:
+            if self.fixed_compute_powers is not None:
+                # user-pinned static shares (reference:
+                # normalizedComputePowersOfDevices, ClNumberCruncher.cs:254-271)
+                shares = self.fixed_compute_powers
+                raw = [total * s for s in shares]
+                ranges = [max(0, int(r / step + 0.5)) * step for r in raw]
+                diff = total - sum(ranges)
+                while diff != 0:
+                    i = max(range(n), key=lambda k: shares[k])
+                    ranges[i] += step if diff > 0 else -step
+                    diff = total - sum(ranges)
+            else:
+                ranges = equal_split(total, n, step)
+        elif rebalance and n > 1 and self.fixed_compute_powers is None:
+            bench = [w.benchmarks.get(compute_id, 0.0) for w in self.workers]
+            if all(b > 0 for b in bench):
+                hist = None
+                if self.smooth_load_balancer:
+                    hist = self.histories.setdefault(compute_id, BalanceHistory())
+                carry = self._cont_ranges.setdefault(compute_id, [])
+                ranges = load_balance(bench, ranges, total, step, hist, carry=carry)
+        self.global_ranges[compute_id] = ranges
+        refs = [0] * n
+        acc = 0
+        for i in range(n):
+            refs[i] = acc
+            acc += ranges[i]
+        self.global_references[compute_id] = refs
+        return ranges, refs
+
+    # -- main entry (reference: Cores.compute, Cores.cs:471-963) -------------
+    def compute(
+        self,
+        kernel_names: Sequence[str],
+        params: Sequence[ClArray],
+        compute_id: int,
+        global_range: int,
+        local_range: int,
+        global_offset: int = 0,
+        pipeline: bool = False,
+        pipeline_blobs: int = 4,
+        pipeline_type: int = PIPELINE_EVENT,
+        cruncher=None,
+        value_args: Sequence | dict = (),
+    ) -> None:
+        for name in kernel_names:
+            if name not in self.program:
+                raise ComputeValidationError(
+                    f"kernel {name!r} not in program; available: {self.program.kernel_names}"
+                )
+            need_vals = self.program.value_param_names(name)
+            given = (
+                len(value_args.get(name, ()))
+                if isinstance(value_args, dict)
+                else len(tuple(value_args))
+            )
+            if need_vals and given != len(need_vals):
+                raise ComputeValidationError(
+                    f"kernel {name!r} takes {len(need_vals)} scalar value argument(s) "
+                    f"{need_vals} but {given} given — pass values=(...) to compute()"
+                )
+        step = local_range * (pipeline_blobs if pipeline else 1)
+        if global_range % step != 0:
+            raise ComputeValidationError(
+                f"global_range ({global_range}) must be divisible by step ({step})"
+            )
+        t_start = time.perf_counter()
+        ranges, refs = self._ranges_for(compute_id, global_range, step, rebalance=True)
+
+        futures = []
+        for i, w in enumerate(self.workers):
+            if ranges[i] <= 0:
+                continue
+            futures.append(
+                self.pool.submit(
+                    self._run_worker,
+                    w,
+                    kernel_names,
+                    params,
+                    compute_id,
+                    global_offset + refs[i],
+                    ranges[i],
+                    local_range,
+                    global_range,
+                    pipeline,
+                    pipeline_blobs,
+                    value_args,
+                )
+            )
+        errs = []
+        for f in futures:
+            try:
+                f.result()
+            except Exception as e:  # surface the first worker error
+                errs.append(e)
+        if errs:
+            raise errs[0]
+
+        perf = ComputePerf(
+            compute_id=compute_id,
+            device_ms=[w.benchmarks.get(compute_id, 0.0) for w in self.workers],
+            device_items=list(ranges),
+            total_ms=(time.perf_counter() - t_start) * 1000.0,
+        )
+        self.perf[compute_id] = perf
+        self.last_compute_id = compute_id
+        if self.performance_feed:
+            print(perf.report(self.device_names()))
+
+    # -- per-worker phase (reference: Cores.cs:746-835 / 1197-1980) ----------
+    def _run_worker(
+        self,
+        w: Worker,
+        kernel_names: Sequence[str],
+        params: Sequence[ClArray],
+        compute_id: int,
+        offset: int,
+        size: int,
+        local_range: int,
+        global_range: int,
+        pipeline: bool,
+        blobs: int,
+        value_args,
+    ) -> None:
+        w.start_bench(compute_id)
+        single = self.num_devices == 1
+        try:
+            if pipeline and blobs > 1:
+                self._run_pipelined(
+                    w, kernel_names, params, compute_id, offset, size,
+                    local_range, global_range, blobs, value_args, single,
+                )
+                return
+            # H2D
+            for idx, p in enumerate(params):
+                fl = p.flags
+                if fl.read and not fl.write_only:
+                    if self.enqueue_mode and id(p) in w._buffers:
+                        continue  # data lives in HBM across enqueued computes
+                    epw = fl.elements_per_work_item
+                    full = single or not fl.partial_read
+                    w.upload(p, offset * epw, size * epw, full)
+                else:
+                    w.ensure_resident(p)
+            # compute
+            if not self.no_compute_mode:
+                w.launch(
+                    self.program, kernel_names, params, value_args,
+                    offset, size, local_range, global_range, local_range,
+                    repeats=self.repeat_count, sync_kernel=self.repeat_sync_kernel,
+                )
+            # D2H
+            handles = []
+            for idx, p in enumerate(params):
+                fl = p.flags
+                if not (fl.write and not fl.read_only):
+                    continue
+                if self.enqueue_mode:
+                    with self._lock:
+                        self._enqueued.append((w, p, offset, size, fl.write_all))
+                    continue
+                epw = fl.elements_per_work_item
+                if fl.write_all:
+                    # whole-array write: only the owning chip writes it back
+                    # (reference rule "device i writes array (i mod numDevices)",
+                    # Worker.cs:871-885)
+                    if w.index == idx % self.num_devices:
+                        handles.append(w.download_async(p, 0, p.size, True))
+                else:
+                    handles.append(w.download_async(p, offset * epw, size * epw, single and not _any_partial(params)))
+            for h in handles:
+                Worker.finish_download(h)
+        finally:
+            w.end_bench(compute_id)
+
+    def _run_pipelined(
+        self,
+        w: Worker,
+        kernel_names: Sequence[str],
+        params: Sequence[ClArray],
+        compute_id: int,
+        offset: int,
+        size: int,
+        local_range: int,
+        global_range: int,
+        blobs: int,
+        value_args,
+        single: bool,
+    ) -> None:
+        """Blob-chunked overlap: issue blob k+1's H2D while blob k computes
+        (reference: the 3-queue event pipeline wavefront, Cores.cs:1252-1363)."""
+        blob = size // blobs
+        if blob <= 0:
+            blob, blobs = size, 1
+        # non-blobbed arrays (not partial) upload once up-front
+        for p in params:
+            fl = p.flags
+            if fl.read and not fl.write_only and not fl.partial_read:
+                w.upload(p, 0, 0, True)
+            elif not fl.read:
+                w.ensure_resident(p)
+        handles = []
+        for k in range(blobs):
+            boff = offset + k * blob
+            for p in params:
+                fl = p.flags
+                if fl.read and not fl.write_only and fl.partial_read:
+                    epw = fl.elements_per_work_item
+                    w.upload(p, boff * epw, blob * epw, False)
+            if not self.no_compute_mode:
+                w.launch(
+                    self.program, kernel_names, params, value_args,
+                    boff, blob, local_range, global_range, local_range,
+                    repeats=self.repeat_count, sync_kernel=self.repeat_sync_kernel,
+                )
+            for idx, p in enumerate(params):
+                fl = p.flags
+                if fl.write and not fl.read_only and not fl.write_all:
+                    epw = fl.elements_per_work_item
+                    handles.append(w.download_async(p, boff * epw, blob * epw, False))
+        for idx, p in enumerate(params):
+            fl = p.flags
+            if fl.write and not fl.read_only and fl.write_all:
+                if w.index == idx % self.num_devices:
+                    handles.append(w.download_async(p, 0, p.size, True))
+        for h in handles:
+            Worker.finish_download(h)
+
+    # -- enqueue-mode sync (reference: flushLastUsedCommandQueue / finish) ----
+    def flush(self) -> None:
+        """Read back and join everything deferred by enqueue mode."""
+        with self._lock:
+            pending, self._enqueued = self._enqueued, []
+        seen: set[tuple[int, int]] = set()
+        handles = []
+        for w, p, offset, size, write_all in pending:
+            key = (id(w), id(p))
+            if key in seen:
+                continue
+            seen.add(key)
+            epw = p.flags.elements_per_work_item
+            if write_all:
+                handles.append(w.download_async(p, 0, p.size, True))
+            else:
+                handles.append(w.download_async(p, offset * epw, size * epw, False))
+        for h in handles:
+            Worker.finish_download(h)
+
+    # -- reporting -----------------------------------------------------------
+    def performance_report(self, compute_id: int | None = None) -> str:
+        cid = compute_id if compute_id is not None else self.last_compute_id
+        if cid is None or cid not in self.perf:
+            return "(no compute has run)"
+        text = self.perf[cid].report(self.device_names())
+        return text
+
+    def benchmarks_of(self, compute_id: int) -> list[float]:
+        return [w.benchmarks.get(compute_id, 0.0) for w in self.workers]
+
+    def ranges_of(self, compute_id: int) -> list[int]:
+        return list(self.global_ranges.get(compute_id, []))
+
+    def dispose(self) -> None:
+        for w in self.workers:
+            w.dispose()
+        self.pool.shutdown(wait=False)
+
+
+def _any_partial(params: Sequence[ClArray]) -> bool:
+    return any(p.flags.partial_read for p in params)

@@ -1,0 +1,156 @@
+"""NumberCruncher — the user-facing facade over the Cores scheduler.
+
+TPU-native analogue of the reference's ``ClNumberCruncher``
+(ClNumberCruncher.cs): construct from an :class:`AcceleratorType` flag or an
+explicit :class:`Devices` selection plus a kernel source (C-subset string,
+``@kernel`` Python functions, or a mix); exposes the runtime toggles —
+``enqueue_mode`` (:125-129), ``no_compute_mode`` (:66-70),
+``performance_feed`` (:174), ``smooth_load_balancer`` (:187),
+``repeat_count``/``repeat_kernel_name`` (:139-166),
+``normalized_compute_powers_of_devices`` (:254-271) — and the error counter
+that refuses further work after a failure (:374-392, ClArray.cs:1610-1623).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+from ..errors import CekirdeklerError
+from ..hardware import AcceleratorType, Devices, devices_for_type
+from ..kernel.registry import KernelProgram, PythonKernel
+from .cores import Cores
+
+__all__ = ["NumberCruncher"]
+
+
+class NumberCruncher:
+    """Compile kernels for the selected chips and treat them as one device."""
+
+    def __init__(
+        self,
+        devices_or_type: Devices | AcceleratorType,
+        kernels: str | PythonKernel | Sequence,
+        max_devices: int = 0,
+    ):
+        if isinstance(devices_or_type, AcceleratorType):
+            devices = devices_for_type(devices_or_type, max_devices)
+        else:
+            devices = devices_or_type
+            if max_devices > 0:
+                devices = devices.subset(max_devices)
+        self.number_of_errors_happened = 0
+        try:
+            self.program = KernelProgram(kernels)
+            self.cores = Cores(devices, self.program)
+        except Exception:
+            self.number_of_errors_happened += 1
+            raise
+        self._disposed = False
+
+    # -- device info ---------------------------------------------------------
+    @property
+    def devices(self) -> Devices:
+        return self.cores.devices
+
+    @property
+    def num_devices(self) -> int:
+        return self.cores.num_devices
+
+    @property
+    def kernel_names(self) -> list[str]:
+        return self.program.kernel_names
+
+    # -- runtime toggles (reference property parity) -------------------------
+    @property
+    def enqueue_mode(self) -> bool:
+        return self.cores.enqueue_mode
+
+    @enqueue_mode.setter
+    def enqueue_mode(self, v: bool) -> None:
+        was = self.cores.enqueue_mode
+        self.cores.enqueue_mode = bool(v)
+        if was and not v:
+            self.cores.flush()  # leaving enqueue mode syncs results to host
+
+    @property
+    def no_compute_mode(self) -> bool:
+        return self.cores.no_compute_mode
+
+    @no_compute_mode.setter
+    def no_compute_mode(self, v: bool) -> None:
+        self.cores.no_compute_mode = bool(v)
+
+    @property
+    def performance_feed(self) -> bool:
+        return self.cores.performance_feed
+
+    @performance_feed.setter
+    def performance_feed(self, v: bool) -> None:
+        self.cores.performance_feed = bool(v)
+
+    @property
+    def smooth_load_balancer(self) -> bool:
+        return self.cores.smooth_load_balancer
+
+    @smooth_load_balancer.setter
+    def smooth_load_balancer(self, v: bool) -> None:
+        self.cores.smooth_load_balancer = bool(v)
+
+    @property
+    def repeat_count(self) -> int:
+        return self.cores.repeat_count
+
+    @repeat_count.setter
+    def repeat_count(self, v: int) -> None:
+        self.cores.repeat_count = max(1, int(v))
+
+    @property
+    def repeat_kernel_name(self) -> str | None:
+        return self.cores.repeat_sync_kernel
+
+    @repeat_kernel_name.setter
+    def repeat_kernel_name(self, name: str | None) -> None:
+        self.cores.repeat_sync_kernel = name
+
+    @property
+    def normalized_compute_powers_of_devices(self) -> list[float] | None:
+        return self.cores.fixed_compute_powers
+
+    @normalized_compute_powers_of_devices.setter
+    def normalized_compute_powers_of_devices(self, powers: Sequence[float] | None) -> None:
+        if powers is None:
+            self.cores.fixed_compute_powers = None
+            return
+        powers = [float(p) for p in powers]
+        if len(powers) != self.num_devices:
+            raise CekirdeklerError(
+                f"need {self.num_devices} compute powers, got {len(powers)}"
+            )
+        s = sum(powers)
+        self.cores.fixed_compute_powers = [p / s for p in powers]
+
+    # -- sync / reporting ----------------------------------------------------
+    def flush(self) -> None:
+        """Join deferred enqueue-mode work (reference:
+        flushLastUsedCommandQueue, ClNumberCruncher.cs:100-106)."""
+        self.cores.flush()
+
+    def performance_report(self, compute_id: int | None = None) -> str:
+        return self.cores.performance_report(compute_id)
+
+    def benchmarks_of(self, compute_id: int) -> list[float]:
+        return self.cores.benchmarks_of(compute_id)
+
+    def ranges_of(self, compute_id: int) -> list[int]:
+        return self.cores.ranges_of(compute_id)
+
+    def dispose(self) -> None:
+        if not self._disposed:
+            self.cores.dispose()
+            self._disposed = True
+
+    def __enter__(self) -> "NumberCruncher":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.dispose()

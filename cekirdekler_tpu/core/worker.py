@@ -1,0 +1,200 @@
+"""Worker — one dispatch lane per TPU chip.
+
+TPU-native analogue of the reference's per-device ``Worker``
+(Worker.cs): owns the chip's buffer cache (the reference's
+``Dictionary<object, ClBuffer>`` keyed by array object, Worker.cs:194,
+576-720), runs H2D → launch → D2H for its assigned sub-range of the global
+work-item range, and keeps per-compute-id wall-time benchmarks that feed the
+load balancer (Worker.cs:753-807).
+
+The reference's 21 command queues become XLA async dispatch: every
+``device_put`` / launch / ``copy_to_host_async`` is an asynchronous
+operation on the chip's stream; blob-chunked launches overlap transfers with
+compute without explicit queue juggling (core/cores.py drives that).
+
+Launch geometry: a chip's quantized range is covered by a *binary ladder* of
+chunk sizes (``step·2^k``), so every geometry the balancer can produce
+compiles at most ``O(log(range/step))`` distinct XLA executables — the
+re-balancer never causes unbounded recompilation (the reference relies on
+NDRange offsets being launch parameters; ours are runtime scalars too).
+"""
+
+from __future__ import annotations
+
+import time
+from functools import partial
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..arrays.clarray import ClArray
+from ..kernel.registry import KernelProgram
+
+__all__ = ["Worker"]
+
+
+@partial(jax.jit, static_argnums=(2,))
+def _slice_out(buf, off, size: int):
+    return lax.dynamic_slice(buf, (jnp.asarray(off, jnp.int32),), (size,))
+
+
+@jax.jit
+def _update_slice(buf, sl, off):
+    return lax.dynamic_update_slice(buf, sl, (jnp.asarray(off, jnp.int32),))
+
+
+def _ladder(size: int, step: int) -> list[int]:
+    """Decompose ``size`` (a multiple of ``step``) into descending
+    ``step·2^k`` chunks — the compile-once launch ladder."""
+    out: list[int] = []
+    units = size // step
+    bit = 1 << (units.bit_length() - 1) if units else 0
+    while units:
+        if bit <= units:
+            out.append(bit * step)
+            units -= bit
+        bit >>= 1
+    return out
+
+
+class Worker:
+    """Per-chip execution engine."""
+
+    def __init__(self, device: jax.Device, index: int):
+        self.device = device
+        self.index = index
+        # array-object → device buffer (reference: Worker.cs:194)
+        self._buffers: dict[int, Any] = {}
+        self._buffer_owner: dict[int, ClArray] = {}  # strong refs, like the reference
+        # per-compute-id accumulated wall ms (reference: Worker.cs:190,753-807)
+        self.benchmarks: dict[int, float] = {}
+        self._bench_t0: dict[int, float] = {}
+
+    # -- benchmarks ----------------------------------------------------------
+    def start_bench(self, compute_id: int) -> None:
+        self._bench_t0[compute_id] = time.perf_counter()
+
+    def end_bench(self, compute_id: int) -> None:
+        t0 = self._bench_t0.pop(compute_id, None)
+        if t0 is not None:
+            self.benchmarks[compute_id] = (time.perf_counter() - t0) * 1000.0
+
+    # -- buffer management ---------------------------------------------------
+    def _buffer_for(self, arr: ClArray) -> Any:
+        key = id(arr)
+        buf = self._buffers.get(key)
+        host = arr.host()
+        if buf is None or buf.shape[0] != host.size or buf.dtype != host.dtype:
+            buf = jax.device_put(jnp.zeros(host.size, host.dtype), self.device)
+            self._buffers[key] = buf
+            self._buffer_owner[key] = arr
+        return buf
+
+    def upload(self, arr: ClArray, offset_elems: int, size_elems: int, full: bool) -> None:
+        """H2D: full array or only this chip's range slice (reference:
+        writeToBuffer / writeToBufferRanged, Worker.cs:821-885)."""
+        key = id(arr)
+        host = arr.host()
+        if full:
+            # numpy → target device directly: wrapping in jnp.asarray first
+            # would land on the default device and force a cross-device copy
+            self._buffers[key] = jax.device_put(host, self.device)
+            self._buffer_owner[key] = arr
+            return
+        buf = self._buffer_for(arr)
+        sl = jax.device_put(host[offset_elems : offset_elems + size_elems], self.device)
+        self._buffers[key] = _update_slice(buf, sl, offset_elems)
+
+    def ensure_resident(self, arr: ClArray) -> Any:
+        """Buffer for a non-read array: reuse cache or zeros (the kernel is
+        expected to produce it)."""
+        return self._buffer_for(arr)
+
+    def buffer(self, arr: ClArray) -> Any:
+        return self._buffers[id(arr)]
+
+    def set_buffer(self, arr: ClArray, buf: Any) -> None:
+        self._buffers[id(arr)] = buf
+        self._buffer_owner[id(arr)] = arr
+
+    def invalidate(self, arr: ClArray) -> None:
+        self._buffers.pop(id(arr), None)
+        self._buffer_owner.pop(id(arr), None)
+
+    # -- launch --------------------------------------------------------------
+    def launch(
+        self,
+        program: KernelProgram,
+        kernel_names: Sequence[str],
+        params: Sequence[ClArray],
+        value_args: Sequence,
+        offset: int,
+        size: int,
+        local_range: int,
+        global_size: int,
+        step: int,
+        repeats: int = 1,
+        sync_kernel: str | None = None,
+    ) -> None:
+        """Run the kernel sequence over work items [offset, offset+size) on
+        this chip.  ``repeats`` reruns the sequence on-device without host
+        round-trips (reference: computeRepeated / repeatCount,
+        Worker.cs:1051-1069); ``sync_kernel`` interleaves a synchronization
+        kernel between repeats (computeRepeatedWithSyncKernel)."""
+        bufs = tuple(self._buffers[id(p)] for p in params)
+        names = list(kernel_names)
+        if repeats > 1 and sync_kernel:
+            seq: list[str] = []
+            for r in range(repeats):
+                seq.extend(names)
+                if r != repeats - 1:
+                    seq.append(sync_kernel)
+            plan = [(seq, 1)]
+        else:
+            plan = [(names, repeats)]
+
+        for names_seq, reps in plan:
+            for _ in range(reps):
+                for name in names_seq:
+                    va = value_args.get(name, ()) if isinstance(value_args, dict) else tuple(value_args)
+                    for chunk in _ladder(size, step):
+                        fn, info = program.launcher(name, chunk, local_range, global_size)
+                        n_arr = program.array_param_count(name)
+                        out = fn(offset, bufs[:n_arr], tuple(va))
+                        bufs = tuple(out) + bufs[n_arr:]
+                        offset += chunk
+                    offset -= size  # rewind for next kernel/repeat
+        for p, b in zip(params, bufs):
+            self._buffers[id(p)] = b
+
+    # -- readback ------------------------------------------------------------
+    def download_async(self, arr: ClArray, offset_elems: int, size_elems: int, full: bool):
+        """D2H: start an async copy of this chip's range (or the full array);
+        returns a handle consumed by :meth:`finish_download`."""
+        buf = self._buffers[id(arr)]
+        if full:
+            out = buf
+            off = 0
+        else:
+            out = _slice_out(buf, offset_elems, size_elems)
+            off = offset_elems
+        try:
+            out.copy_to_host_async()
+        except Exception:
+            pass
+        return (arr, out, off)
+
+    @staticmethod
+    def finish_download(handle) -> None:
+        arr, out, off = handle
+        host = arr.host()
+        data = np.asarray(out)
+        host[off : off + data.size] = data
+
+    def dispose(self) -> None:
+        self._buffers.clear()
+        self._buffer_owner.clear()
+        self.benchmarks.clear()

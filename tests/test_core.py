@@ -1,0 +1,254 @@
+"""Core runtime tests: NumberCruncher + Cores scheduler over the 8-device
+virtual rig (reference test pattern: Tester.cs correctness matrix — verify
+element-wise against host references for every transfer-flag combination,
+device count, and pipeline mode)."""
+
+import numpy as np
+import pytest
+
+from cekirdekler_tpu import ClArray
+from cekirdekler_tpu.core import PIPELINE_DRIVER, PIPELINE_EVENT, NumberCruncher
+from cekirdekler_tpu.errors import ComputeValidationError
+from cekirdekler_tpu.hardware import platforms
+from cekirdekler_tpu.kernel import kernel
+
+VADD = """
+__kernel void vadd(__global float* a, __global float* b, __global float* c) {
+    int i = get_global_id(0);
+    c[i] = a[i] + b[i];
+}
+__kernel void scale2(__global float* a, __global float* b, __global float* c) {
+    int i = get_global_id(0);
+    c[i] = c[i] * 2.0f;
+}
+__kernel void inc(__global float* a) {
+    int i = get_global_id(0);
+    a[i] = a[i] + 1.0f;
+}
+"""
+
+
+@pytest.fixture(scope="module")
+def devs():
+    return platforms().cpus()
+
+
+def make_abc(n=1024, partial=True):
+    a = ClArray(np.arange(n, dtype=np.float32), name="a")
+    b = ClArray(np.ones(n, dtype=np.float32), name="b")
+    c = ClArray(n, name="c")
+    if partial:
+        a.partial_read = True
+        b.partial_read = True
+    return a, b, c
+
+
+@pytest.mark.parametrize("ndev", [1, 2, 3, 8])
+def test_vadd_device_counts(devs, ndev):
+    cr = NumberCruncher(devs.subset(ndev), VADD)
+    a, b, c = make_abc()
+    a.next_param(b).next_param(c).compute(cr, 1, "vadd", 1024, 64)
+    np.testing.assert_allclose(np.asarray(c), np.arange(1024) + 1)
+    assert sum(cr.ranges_of(1)) == 1024
+    cr.dispose()
+
+
+@pytest.mark.parametrize("ptype", [PIPELINE_EVENT, PIPELINE_DRIVER])
+def test_vadd_pipelined(devs, ptype):
+    cr = NumberCruncher(devs.subset(4), VADD)
+    a, b, c = make_abc(4096)
+    c.write = True
+    g = a.next_param(b).next_param(c)
+    g.compute(cr, 1, "vadd", 4096, 64, pipeline=True, pipeline_blobs=4, pipeline_type=ptype)
+    np.testing.assert_allclose(np.asarray(c), np.arange(4096) + 1)
+    cr.dispose()
+
+
+def test_multi_kernel_sequence(devs):
+    """'vadd scale2' runs kernels in order over the same args."""
+    cr = NumberCruncher(devs.subset(2), VADD)
+    a, b, c = make_abc()
+    a.next_param(b).next_param(c).compute(cr, 7, "vadd scale2", 1024, 64)
+    np.testing.assert_allclose(np.asarray(c), (np.arange(1024) + 1) * 2)
+    cr.dispose()
+
+
+def test_single_array_inplace(devs):
+    cr = NumberCruncher(devs.subset(4), VADD)
+    a = ClArray(np.zeros(512, np.float32), name="x")
+    a.partial_read = True
+    for it in range(3):
+        a.compute(cr, 3, "inc", 512, 64)
+    np.testing.assert_allclose(np.asarray(a), 3.0)
+    cr.dispose()
+
+
+def test_balancer_iterates_on_virtual_devices(devs):
+    cr = NumberCruncher(devs.subset(4), VADD)
+    a, b, c = make_abc(4096)
+    g = a.next_param(b).next_param(c)
+    for _ in range(8):
+        g.compute(cr, 1, "vadd", 4096, 64)
+    r = cr.ranges_of(1)
+    assert sum(r) == 4096 and all(x % 64 == 0 for x in r)
+    bench = cr.benchmarks_of(1)
+    assert all(m > 0 for m in bench)
+    rep = cr.performance_report(1)
+    assert "workitems" in rep and "load" in rep
+    cr.dispose()
+
+
+def test_full_read_non_partial(devs):
+    """Without partial_read every chip gets the whole input (needed for
+    gather-style kernels reading outside their range)."""
+    src = """
+    __kernel void rev(__global float* a, __global float* b, int n) {
+        int i = get_global_id(0);
+        b[i] = a[n - 1 - i];
+    }"""
+    cr = NumberCruncher(devs.subset(4), src)
+    n = 512
+    a = ClArray(np.arange(n, dtype=np.float32), name="a")  # full read (default)
+    b = ClArray(n, name="b")
+    a.next_param(b).compute(cr, 1, "rev", n, 64, values=(n,))
+    np.testing.assert_allclose(np.asarray(b), np.arange(n)[::-1])
+    cr.dispose()
+
+
+def test_write_all(devs):
+    """write_all: one owning chip writes the entire array back."""
+    src = """
+    __kernel void fill(__global float* out) {
+        int i = get_global_id(0);
+        if (i == 0) {
+            for (int j = 0; j < 64; j++) { out[j] = 5.0f; }
+        }
+    }"""
+    cr = NumberCruncher(devs.subset(2), src)
+    out = ClArray(np.zeros(64, np.float32), name="o")
+    out.read = False
+    out.write_all = True
+    out.compute(cr, 1, "fill", 64, 32)
+    np.testing.assert_allclose(np.asarray(out), 5.0)
+    cr.dispose()
+
+
+def test_read_only_not_written_back(devs):
+    cr = NumberCruncher(devs.subset(2), VADD)
+    a, b, c = make_abc()
+    a.read_only = True
+    b.read_only = True
+    a.next_param(b).next_param(c).compute(cr, 1, "vadd", 1024, 64)
+    np.testing.assert_allclose(np.asarray(c), np.arange(1024) + 1)
+    np.testing.assert_allclose(np.asarray(a), np.arange(1024))  # untouched
+    cr.dispose()
+
+
+def test_write_only_skips_upload(devs):
+    src = """
+    __kernel void seven(__global float* o) {
+        int i = get_global_id(0);
+        o[i] = 7.0f;
+    }"""
+    cr = NumberCruncher(devs.subset(2), src)
+    o = ClArray(np.full(256, -1, np.float32), name="o")
+    o.write_only = True
+    o.compute(cr, 1, "seven", 256, 64)
+    np.testing.assert_allclose(np.asarray(o), 7.0)
+    cr.dispose()
+
+
+def test_enqueue_mode_defers_readback(devs):
+    cr = NumberCruncher(devs.subset(2), VADD)
+    x = ClArray(np.zeros(256, np.float32), name="x")
+    x.partial_read = True
+    cr.enqueue_mode = True
+    for _ in range(5):
+        x.compute(cr, 1, "inc", 256, 64)
+    # host not yet updated (results still in HBM)
+    assert np.all(np.asarray(x) == 0.0)
+    cr.enqueue_mode = False  # leaving enqueue mode flushes
+    np.testing.assert_allclose(np.asarray(x), 5.0)
+    cr.dispose()
+
+
+def test_repeat_count_on_device(devs):
+    cr = NumberCruncher(devs.subset(2), VADD)
+    x = ClArray(np.zeros(256, np.float32), name="x")
+    x.partial_read = True
+    cr.repeat_count = 10
+    x.compute(cr, 1, "inc", 256, 64)
+    np.testing.assert_allclose(np.asarray(x), 10.0)
+    cr.dispose()
+
+
+def test_value_args_passthrough(devs):
+    src = """
+    __kernel void axpb(__global float* x, float aa, float bb) {
+        int i = get_global_id(0);
+        x[i] = aa * x[i] + bb;
+    }"""
+    cr = NumberCruncher(devs.subset(2), src)
+    x = ClArray(np.ones(128, np.float32), name="x")
+    x.partial_read = True
+    x.compute(cr, 1, "axpb", 128, 64, values=(2.0, 5.0))
+    np.testing.assert_allclose(np.asarray(x), 7.0)
+    cr.dispose()
+
+
+def test_fixed_compute_powers(devs):
+    cr = NumberCruncher(devs.subset(2), VADD)
+    cr.normalized_compute_powers_of_devices = [3, 1]
+    a, b, c = make_abc()
+    a.next_param(b).next_param(c).compute(cr, 1, "vadd", 1024, 64)
+    r = cr.ranges_of(1)
+    assert r[0] == 768 and r[1] == 256
+    np.testing.assert_allclose(np.asarray(c), np.arange(1024) + 1)
+    cr.dispose()
+
+
+def test_separate_compute_ids_independent(devs):
+    cr = NumberCruncher(devs.subset(4), VADD)
+    a, b, c = make_abc(512)
+    g = a.next_param(b).next_param(c)
+    g.compute(cr, 1, "vadd", 512, 64)
+    g.compute(cr, 2, "vadd", 512, 64)
+    assert cr.ranges_of(1) == cr.ranges_of(2)
+    assert 1 in cr.cores.perf and 2 in cr.cores.perf
+    cr.dispose()
+
+
+def test_validation_errors(devs):
+    cr = NumberCruncher(devs.subset(2), VADD)
+    a, b, c = make_abc(128)
+    g = a.next_param(b).next_param(c)
+    with pytest.raises(ComputeValidationError):
+        g.compute(cr, 1, "vadd", 100, 64)  # not divisible
+    with pytest.raises(ComputeValidationError):
+        g.compute(cr, 1, "nosuch", 128, 64)
+    with pytest.raises(ComputeValidationError):
+        g.compute(cr, 1, "vadd", 256, 64)  # arrays too small
+    cr.dispose()
+
+
+def test_python_kernel_through_cruncher(devs):
+    @kernel
+    def triple(gid, a):
+        return a.at[gid].multiply(3.0)
+
+    cr = NumberCruncher(devs.subset(2), triple)
+    x = ClArray(np.ones(256, np.float32), name="x")
+    x.partial_read = True
+    x.compute(cr, 1, "triple", 256, 64)
+    np.testing.assert_allclose(np.asarray(x), 3.0)
+    cr.dispose()
+
+
+def test_fastarr_backed_compute(devs):
+    cr = NumberCruncher(devs.subset(2), VADD)
+    a, b, c = make_abc()
+    a.fast_arr = True
+    c.fast_arr = True
+    a.next_param(b).next_param(c).compute(cr, 1, "vadd", 1024, 64)
+    np.testing.assert_allclose(np.asarray(c), np.arange(1024) + 1)
+    cr.dispose()
