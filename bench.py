@@ -1,0 +1,54 @@
+#!/usr/bin/env python
+"""Headline benchmark: mandelbrot throughput (Mpixels/sec) across all
+available chips with iterative load balancing — BASELINE.md's primary
+metric.
+
+Prints exactly one JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+``vs_baseline`` is measured against the unscheduled path on one chip (no
+load balancing across chips, no transfer/compute overlap) — the reference
+repo publishes no absolute numbers (BASELINE.md), so the baseline is the
+same workload without the framework's scheduling, i.e. the quantity its
+pipelining/balancing claims (Cores.cs:467) are about.
+"""
+
+import json
+import sys
+import time
+
+
+def main() -> None:
+    import cekirdekler_tpu as ct
+    from cekirdekler_tpu.workloads import run_mandelbrot
+
+    devs = ct.all_devices()
+    tpus = devs.tpus()
+    if len(tpus):
+        devs = tpus  # headline number is per-chip TPU throughput
+    width = height = 2048
+    max_iter = 256
+
+    # Baseline: single chip, no pipelining (plain H2D→launch→D2H each call).
+    base = run_mandelbrot(
+        devs.subset(1), width=width, height=height, max_iter=max_iter,
+        iters=6, warmup=2, pipeline=False,
+    )
+
+    # Framework path: every chip, blob-pipelined overlap + load balancer.
+    full = run_mandelbrot(
+        devs, width=width, height=height, max_iter=max_iter,
+        iters=10, warmup=3, pipeline=True, pipeline_blobs=8,
+    )
+
+    result = {
+        "metric": "mandelbrot_throughput",
+        "value": round(full.mpixels_per_sec, 3),
+        "unit": "Mpixels/sec",
+        "vs_baseline": round(full.mpixels_per_sec / max(base.mpixels_per_sec, 1e-9), 3),
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

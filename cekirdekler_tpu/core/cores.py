@@ -172,7 +172,29 @@ class Cores:
                 f"global_range ({global_range}) must be divisible by step ({step})"
             )
         t_start = time.perf_counter()
-        ranges, refs = self._ranges_for(compute_id, global_range, step, rebalance=True)
+        # enqueue mode pins the ranges: data stays resident per the current
+        # partition, so moving shares between chips would compute on stale
+        # regions (the reference supports enqueue mode on the single-device
+        # path only, Cores.cs:836-949)
+        ranges, refs = self._ranges_for(
+            compute_id, global_range, step, rebalance=not self.enqueue_mode
+        )
+        # a chip whose share was quantized to zero never re-runs its bench;
+        # decay its stale measurement so a one-off slow call (e.g. first-call
+        # compile) cannot starve it permanently
+        for i, w in enumerate(self.workers):
+            if ranges[i] <= 0 and w.benchmarks.get(compute_id, 0.0) > 0.0:
+                w.benchmarks[compute_id] *= 0.5
+
+        # write_all owner: "device i writes array (i mod numDevices)"
+        # (Worker.cs:871-885) — but only among chips that actually run,
+        # else a starved owner would silently skip the readback
+        active = [i for i in range(self.num_devices) if ranges[i] > 0]
+        write_all_owner = {
+            idx: active[idx % len(active)]
+            for idx, p in enumerate(params)
+            if p.flags.write_all and active
+        }
 
         futures = []
         for i, w in enumerate(self.workers):
@@ -192,6 +214,7 @@ class Cores:
                     pipeline,
                     pipeline_blobs,
                     value_args,
+                    write_all_owner,
                 )
             )
         errs = []
@@ -228,6 +251,7 @@ class Cores:
         pipeline: bool,
         blobs: int,
         value_args,
+        write_all_owner: dict[int, int],
     ) -> None:
         w.start_bench(compute_id)
         single = self.num_devices == 1
@@ -236,6 +260,7 @@ class Cores:
                 self._run_pipelined(
                     w, kernel_names, params, compute_id, offset, size,
                     local_range, global_range, blobs, value_args, single,
+                    write_all_owner,
                 )
                 return
             # H2D
@@ -269,12 +294,17 @@ class Cores:
                 epw = fl.elements_per_work_item
                 if fl.write_all:
                     # whole-array write: only the owning chip writes it back
-                    # (reference rule "device i writes array (i mod numDevices)",
-                    # Worker.cs:871-885)
-                    if w.index == idx % self.num_devices:
+                    if w.index == write_all_owner.get(idx):
                         handles.append(w.download_async(p, 0, p.size, True))
                 else:
-                    handles.append(w.download_async(p, offset * epw, size * epw, single and not _any_partial(params)))
+                    # full (no-slice) download only when the range covers the
+                    # whole array — else it would overwrite host elements the
+                    # kernel never touched
+                    covers = offset == 0 and size * epw == p.size
+                    full = single and not _any_partial(params) and covers
+                    handles.append(
+                        w.download_async(p, offset * epw, size * epw, full)
+                    )
             for h in handles:
                 Worker.finish_download(h)
         finally:
@@ -293,18 +323,24 @@ class Cores:
         blobs: int,
         value_args,
         single: bool,
+        write_all_owner: dict[int, int],
     ) -> None:
         """Blob-chunked overlap: issue blob k+1's H2D while blob k computes
         (reference: the 3-queue event pipeline wavefront, Cores.cs:1252-1363)."""
         blob = size // blobs
         if blob <= 0:
             blob, blobs = size, 1
+        # enqueue mode: snapshot residency BEFORE any uploads — a buffer
+        # created by blob 1 must not suppress blobs 2..N of the same call
+        resident = {id(p) for p in params if id(p) in w._buffers} if self.enqueue_mode else set()
         # non-blobbed arrays (not partial) upload once up-front
         for p in params:
             fl = p.flags
-            if fl.read and not fl.write_only and not fl.partial_read:
-                w.upload(p, 0, 0, True)
-            elif not fl.read:
+            reads = fl.read and not fl.write_only
+            if reads and not fl.partial_read:
+                if id(p) not in resident:
+                    w.upload(p, 0, 0, True)
+            elif not reads:
                 w.ensure_resident(p)
         handles = []
         for k in range(blobs):
@@ -312,6 +348,8 @@ class Cores:
             for p in params:
                 fl = p.flags
                 if fl.read and not fl.write_only and fl.partial_read:
+                    if id(p) in resident:
+                        continue
                     epw = fl.elements_per_work_item
                     w.upload(p, boff * epw, blob * epw, False)
             if not self.no_compute_mode:
@@ -323,13 +361,24 @@ class Cores:
             for idx, p in enumerate(params):
                 fl = p.flags
                 if fl.write and not fl.read_only and not fl.write_all:
+                    if self.enqueue_mode:
+                        continue  # deferred below as one whole-range record
                     epw = fl.elements_per_work_item
                     handles.append(w.download_async(p, boff * epw, blob * epw, False))
         for idx, p in enumerate(params):
             fl = p.flags
-            if fl.write and not fl.read_only and fl.write_all:
-                if w.index == idx % self.num_devices:
-                    handles.append(w.download_async(p, 0, p.size, True))
+            if not (fl.write and not fl.read_only):
+                continue
+            if fl.write_all:
+                if w.index == write_all_owner.get(idx):
+                    if self.enqueue_mode:
+                        with self._lock:
+                            self._enqueued.append((w, p, 0, p.size, True))
+                    else:
+                        handles.append(w.download_async(p, 0, p.size, True))
+            elif self.enqueue_mode:
+                with self._lock:
+                    self._enqueued.append((w, p, offset, size, False))
         for h in handles:
             Worker.finish_download(h)
 
@@ -340,7 +389,9 @@ class Cores:
             pending, self._enqueued = self._enqueued, []
         seen: set[tuple[int, int]] = set()
         handles = []
-        for w, p, offset, size, write_all in pending:
+        # keep the most recent record per (worker, array) — it reflects the
+        # latest device contents
+        for w, p, offset, size, write_all in reversed(pending):
             key = (id(w), id(p))
             if key in seen:
                 continue

@@ -1,0 +1,117 @@
+"""Workload self-checks: mandelbrot vs host reference, nbody tolerance
+pattern, streaming add — the reference's benchmark set (Tester.cs) as
+tests on the 8-virtual-device rig."""
+
+import numpy as np
+import pytest
+
+import cekirdekler_tpu as ct
+from cekirdekler_tpu.workloads import (
+    MANDELBROT_SRC,
+    mandelbrot_host,
+    run_mandelbrot,
+    run_nbody,
+    run_stream,
+)
+
+
+def _assert_images_match(got, want, budget=1e-3):
+    """Escape-time counts are chaotic at the set boundary: XLA contracts
+    a*b+c into FMAs, so a handful of boundary pixels legitimately differ
+    from strict-f32 numpy.  Require bitwise agreement on all but a tiny
+    fraction."""
+    got = np.ravel(got)
+    frac = float(np.mean(got != want))
+    assert frac <= budget, f"{frac * 100:.3f}% of pixels differ (budget {budget * 100}%)"
+
+
+def _cpus():
+    """The deterministic 8-virtual-device rig (a real TPU chip may also be
+    visible through the tunnel; exact-equality tests must not mix the two
+    — TPU f32 differs by 1 ULP at mandelbrot escape boundaries)."""
+    return ct.all_devices().cpus().require_nonempty("cpu test rig")
+
+
+def test_mandelbrot_matches_host_single_device():
+    res = run_mandelbrot(
+        _cpus().subset(1), width=256, height=128, max_iter=64,
+        iters=1, warmup=0, keep_image=True,
+    )
+    want = mandelbrot_host(256, 128, -2.0, -1.25, 2.5 / 256, 2.5 / 128, 64)
+    _assert_images_match(res.image, want)
+
+
+def test_mandelbrot_multichip_matches_host():
+    res = run_mandelbrot(
+        _cpus(), width=512, height=256, max_iter=48,
+        iters=4, warmup=0, keep_image=True, local_range=128,
+    )
+    want = mandelbrot_host(512, 256, -2.0, -1.25, 2.5 / 512, 2.5 / 256, 48)
+    _assert_images_match(res.image, want)
+    # the balancer actually split work across chips
+    assert len(res.ranges_per_iter[-1]) == len(_cpus())
+    assert sum(res.ranges_per_iter[-1]) == 512 * 256
+
+
+def test_mandelbrot_pipelined_matches_host():
+    res = run_mandelbrot(
+        _cpus().subset(2), width=512, height=128, max_iter=32,
+        iters=2, warmup=0, keep_image=True, local_range=64,
+        pipeline=True, pipeline_blobs=4,
+    )
+    want = mandelbrot_host(512, 128, -2.0, -1.25, 2.5 / 512, 2.5 / 128, 32)
+    _assert_images_match(res.image, want)
+
+
+def test_nbody_self_check():
+    out = run_nbody(_cpus(), n=1024, iters=3, local_range=128)
+    assert out["checked"]
+    assert len(out["per_iter_ms"]) == 3
+
+
+def test_stream_add():
+    out = run_stream(_cpus().subset(2), n=1 << 16, reps=3, blobs=4, local_range=64)
+    assert out["gb_per_sec"] > 0
+
+
+def test_enqueue_mode_with_pipeline_flushes_correctly():
+    """Regression: pipelined computes under enqueue mode must defer readbacks
+    to flush() and must not skip blob uploads after blob 1 creates the
+    buffer."""
+    from cekirdekler_tpu.arrays.clarray import ClArray
+    from cekirdekler_tpu.core.cruncher import NumberCruncher
+    from cekirdekler_tpu.workloads import STREAM_SRC
+
+    n = 1 << 14
+    a = ClArray(np.arange(n, dtype=np.float32), partial_read=True, read_only=True)
+    b = ClArray(np.ones(n, dtype=np.float32), partial_read=True, read_only=True)
+    c = ClArray(n, np.float32, write_only=True)
+    cr = NumberCruncher(_cpus().subset(4), STREAM_SRC)
+    try:
+        cr.enqueue_mode = True
+        g = a.next_param(b, c)
+        for _ in range(3):
+            g.compute(cr, 1, "streamAdd", n, 64, pipeline=True, pipeline_blobs=4)
+        cr.enqueue_mode = False  # leaving enqueue mode flushes
+        assert np.array_equal(c.host(), a.host() + b.host())
+    finally:
+        cr.dispose()
+
+
+def test_partial_range_readback_preserves_host_outside_range():
+    """Regression: a single-device compute over a prefix of the array must
+    not overwrite host elements beyond the computed range."""
+    from cekirdekler_tpu.arrays.clarray import ClArray
+    from cekirdekler_tpu.core.cruncher import NumberCruncher
+
+    out = ClArray(np.full(512, -7.0, np.float32), read=False, write=True)
+    cr = NumberCruncher(
+        _cpus().subset(1),
+        "__kernel void f(__global float* o){ int i=get_global_id(0); o[i]=2.0f; }",
+    )
+    try:
+        out.compute(cr, 2, "f", 256, 64)
+        assert np.all(out.host()[:256] == 2.0)
+        assert np.all(out.host()[256:] == -7.0)
+    finally:
+        cr.dispose()
