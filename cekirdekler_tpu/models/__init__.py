@@ -1,0 +1,10 @@
+"""Model families built on the parallel tier.
+
+The flagship is the decoder-only :class:`Transformer` (transformer.py) —
+it exercises dp/fsdp/tp/sp shardings, ring/Ulysses attention, remat, and
+the full train step the driver dry-runs multi-chip.
+"""
+
+from .transformer import Transformer, TransformerConfig, cross_entropy_loss
+
+__all__ = ["Transformer", "TransformerConfig", "cross_entropy_loss"]

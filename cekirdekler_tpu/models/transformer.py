@@ -1,0 +1,210 @@
+"""Flagship model family: a decoder-only transformer, TPU-first.
+
+The reference framework predates ML models (its "models" are demo kernels,
+SURVEY.md §2.1 #17/#20); this family exists because a complete TPU compute
+framework must demonstrate the parallel tier end-to-end — dp/fsdp/tp/sp
+shardings, ring/Ulysses long-context attention (parallel/attention.py),
+remat, and a full jittable train step over a mesh.
+
+Design choices (TPU-first, SURVEY.md §7 design stance):
+- Params are plain pytrees (dicts) with a parallel pytree of
+  ``PartitionSpec`` — GSPMD places every matmul; no manual collectives in
+  the dense path.
+- Compute in bfloat16 (MXU-native), params + optimizer state in float32.
+- ``jax.checkpoint`` on each block when ``remat=True`` — recompute
+  activations in backward, trading FLOPs for HBM.
+- Static shapes; layers scanned-free (unrolled python loop — layer count
+  is static) so XLA sees one big fusable graph.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..parallel.attention import attention_reference, ring_attention, ulysses_attention
+from ..parallel.mesh import constrain
+
+__all__ = ["TransformerConfig", "Transformer", "cross_entropy_loss"]
+
+
+@dataclass(frozen=True)
+class TransformerConfig:
+    vocab: int = 32000
+    d_model: int = 512
+    n_layers: int = 4
+    n_heads: int = 8
+    d_ff: int = 2048
+    max_seq: int = 2048
+    dtype: Any = jnp.bfloat16          # activation/compute dtype (MXU-native)
+    param_dtype: Any = jnp.float32
+    attention: str = "dense"            # "dense" | "ring" | "ulysses"
+    remat: bool = False
+    sp_axis: str = "sp"
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+
+def _init(rng, shape, scale, dtype):
+    return (jax.random.normal(rng, shape) * scale).astype(dtype)
+
+
+class Transformer:
+    """Decoder-only transformer with mesh-aware sharding specs."""
+
+    def __init__(self, config: TransformerConfig):
+        self.config = config
+
+    # -- parameters ----------------------------------------------------------
+    def init(self, rng) -> dict:
+        c = self.config
+        keys = jax.random.split(rng, 2 + c.n_layers)
+        params: dict = {
+            "embed": _init(keys[0], (c.vocab, c.d_model), 0.02, c.param_dtype),
+            "final_norm": jnp.ones((c.d_model,), c.param_dtype),
+            "blocks": [],
+        }
+        for i in range(c.n_layers):
+            ks = jax.random.split(keys[2 + i], 4)
+            d, h, f = c.d_model, c.n_heads * c.head_dim, c.d_ff
+            params["blocks"].append(
+                {
+                    "ln1": jnp.ones((d,), c.param_dtype),
+                    "wqkv": _init(ks[0], (d, 3 * h), d**-0.5, c.param_dtype),
+                    "wo": _init(ks[1], (h, d), h**-0.5, c.param_dtype),
+                    "ln2": jnp.ones((d,), c.param_dtype),
+                    "w1": _init(ks[2], (d, f), d**-0.5, c.param_dtype),
+                    "w2": _init(ks[3], (f, d), f**-0.5, c.param_dtype),
+                }
+            )
+        return params
+
+    def param_specs(self) -> dict:
+        """PartitionSpec pytree matching :meth:`init` — tp shards the head
+        and ff dimensions, fsdp shards the other matmul dimension."""
+        c = self.config
+        block = {
+            "ln1": P(),
+            "wqkv": P("fsdp", "tp"),
+            "wo": P("tp", "fsdp"),
+            "ln2": P(),
+            "w1": P("fsdp", "tp"),
+            "w2": P("tp", "fsdp"),
+        }
+        return {
+            "embed": P("tp", "fsdp"),
+            "final_norm": P(),
+            "blocks": [dict(block) for _ in range(c.n_layers)],
+        }
+
+    def shard_params(self, params: dict, mesh: Mesh) -> dict:
+        return jax.tree_util.tree_map(
+            lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+            params,
+            self.param_specs(),
+        )
+
+    # -- forward -------------------------------------------------------------
+    def _attention(self, q, k, v, mesh: Mesh | None):
+        c = self.config
+        if c.attention in ("ring", "ulysses") and mesh is not None:
+            # sequence-parallel paths run under shard_map: batch over the
+            # data axes, sequence over sp, heads over tp; the ring/all-to-all
+            # collectives ride the sp axis only
+            inner = ring_attention if c.attention == "ring" else ulysses_attention
+            spec = P(("dp", "fsdp"), c.sp_axis, "tp", None)
+            fn = jax.shard_map(
+                partial(inner, axis=c.sp_axis, causal=True),
+                mesh=mesh,
+                in_specs=(spec,) * 3,
+                out_specs=spec,
+            )
+            return fn(q, k, v)
+        return attention_reference(q, k, v, causal=True)
+
+    def _block(self, params: dict, x, mesh: Mesh | None):
+        """Pre-norm block: x + Attn(LN(x)); x + MLP(LN(x))."""
+        c = self.config
+        B, T, _ = x.shape
+        h = _rms_norm(x, params["ln1"])
+        qkv = h @ params["wqkv"].astype(c.dtype)
+        if mesh is not None:
+            qkv = constrain(qkv, mesh, ("dp", "fsdp"), c.sp_axis, "tp")
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        shp = (B, T, c.n_heads, c.head_dim)
+        o = self._attention(q.reshape(shp), k.reshape(shp), v.reshape(shp), mesh)
+        o = o.reshape(B, T, -1) @ params["wo"].astype(c.dtype)
+        if mesh is not None:
+            o = constrain(o, mesh, ("dp", "fsdp"), c.sp_axis, None)
+        x = x + o
+        h = _rms_norm(x, params["ln2"])
+        h = jax.nn.gelu(h @ params["w1"].astype(c.dtype))
+        if mesh is not None:
+            h = constrain(h, mesh, ("dp", "fsdp"), c.sp_axis, "tp")
+        h = h @ params["w2"].astype(c.dtype)
+        return x + h
+
+    def apply(self, params: dict, tokens, mesh: Mesh | None = None):
+        """tokens [B, T] int32 → logits [B, T, vocab] (f32)."""
+        c = self.config
+        x = params["embed"].astype(c.dtype)[tokens]
+        if mesh is not None:
+            x = constrain(x, mesh, ("dp", "fsdp"), c.sp_axis, None)
+        def block(bp, x):
+            return self._block(bp, x, mesh)
+
+        if c.remat:
+            block = jax.checkpoint(block)
+        for bp in params["blocks"]:
+            x = block(bp, x)
+        x = _rms_norm(x, params["final_norm"])
+        logits = x.astype(jnp.float32) @ params["embed"].astype(jnp.float32).T
+        if mesh is not None:
+            logits = constrain(logits, mesh, ("dp", "fsdp"), c.sp_axis, "tp")
+        return logits
+
+    # -- training ------------------------------------------------------------
+    def loss_fn(self, params: dict, batch: dict, mesh: Mesh | None = None):
+        """Next-token cross entropy; batch = {"tokens": [B, T+1]}."""
+        tokens = batch["tokens"]
+        logits = self.apply(params, tokens[:, :-1], mesh)
+        return cross_entropy_loss(logits, tokens[:, 1:])
+
+    def make_train_step(self, optimizer, mesh: Mesh | None = None) -> Callable:
+        """Build the full jittable train step: loss, grads, optax update.
+
+        Returns ``step(params, opt_state, batch) -> (params, opt_state,
+        loss)``; caller jits (optionally with shardings over ``mesh``).
+        """
+
+        def step(params, opt_state, batch):
+            loss, grads = jax.value_and_grad(
+                lambda p, b: self.loss_fn(p, b, mesh)
+            )(params, batch)
+            updates, opt_state = optimizer.update(grads, opt_state, params)
+            params = jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
+            return params, opt_state, loss
+
+        return step
+
+
+def _rms_norm(x, gain):
+    dt = x.dtype
+    xf = x.astype(jnp.float32)
+    y = xf * jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + 1e-6)
+    return (y * gain.astype(jnp.float32)).astype(dt)
+
+
+def cross_entropy_loss(logits, labels):
+    """Mean next-token cross entropy (f32)."""
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    ll = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    return -ll.mean()

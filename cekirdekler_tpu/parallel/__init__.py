@@ -1,0 +1,64 @@
+"""Parallelism tier: meshes, shardings, collectives, long-context attention.
+
+TPU-native replacements for the reference's parallelism strategies
+(SURVEY.md §2.3 table): data-parallel range splitting becomes mesh
+data axes; device→device pipelines become ``ppermute`` rings; the TCP
+cluster tier becomes multi-host meshes over DCN; and the long-context
+extensions (ring attention, Ulysses) ride the ``sp`` axis.
+"""
+
+from .attention import (
+    attention_reference,
+    ring_attention,
+    ring_attention_sharded,
+    ulysses_attention,
+    ulysses_attention_sharded,
+)
+from .collectives import (
+    all_gather,
+    all_to_all,
+    axis_index,
+    axis_size,
+    pmax,
+    pmean,
+    ppermute_ring,
+    psum,
+    reduce_scatter,
+    ring_next,
+    ring_prev,
+)
+from .mesh import (
+    AXIS_NAMES,
+    auto_mesh,
+    constrain,
+    make_mesh,
+    named_sharding,
+    replicated,
+    shard_batch,
+)
+
+__all__ = [
+    "AXIS_NAMES",
+    "all_gather",
+    "all_to_all",
+    "attention_reference",
+    "auto_mesh",
+    "axis_index",
+    "axis_size",
+    "constrain",
+    "make_mesh",
+    "named_sharding",
+    "pmax",
+    "pmean",
+    "ppermute_ring",
+    "psum",
+    "reduce_scatter",
+    "replicated",
+    "ring_attention",
+    "ring_attention_sharded",
+    "ring_next",
+    "ring_prev",
+    "shard_batch",
+    "ulysses_attention",
+    "ulysses_attention_sharded",
+]

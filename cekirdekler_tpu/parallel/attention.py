@@ -1,0 +1,162 @@
+"""Long-context attention parallelism: ring attention and Ulysses.
+
+The reference has no sequence dimension (SURVEY.md §5.7) — its contiguous
+range-split + per-chunk pipelining machinery is the skeleton these extend.
+Two first-class strategies over the ``sp`` mesh axis:
+
+- **Ring attention**: K/V shards rotate around the ICI ring via
+  ``ppermute`` while each chip accumulates its queries' attention with a
+  numerically-stable running softmax (flash-attention style
+  max/sum carries).  Memory per chip stays O(T/n); the ring fully hides
+  K/V transfer behind the block einsums on TPU.
+- **Ulysses**: ``all_to_all`` re-shards sequence↔heads so each chip runs
+  dense attention for H/n heads over the full sequence, then transposes
+  back.  Cheaper collectives for moderate T; requires H % n == 0.
+
+Inner functions run inside ``shard_map`` (axis bound by the mesh); the
+``*_sharded`` wrappers build the shard_map over a framework mesh.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from .collectives import all_to_all, ppermute_ring
+
+# XLA's DEFAULT matmul precision may decompose f32 matmuls into bf16 passes
+# (MXU-friendly but ~1e-2 relative error on scores); attention quality work
+# wants true-f32 products, so every einsum here pins HIGHEST.
+_PREC = lax.Precision.HIGHEST
+
+__all__ = [
+    "attention_reference",
+    "ring_attention",
+    "ulysses_attention",
+    "ring_attention_sharded",
+    "ulysses_attention_sharded",
+]
+
+
+def attention_reference(q, k, v, causal: bool = False):
+    """Dense single-device attention (f32 softmax) — the host reference
+    implementation the parallel forms are tested against.
+
+    Shapes: q [B, Tq, H, D], k/v [B, Tk, H, D] → [B, Tq, H, D].
+    """
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    s = jnp.einsum(
+        "bqhd,bkhd->bhqk",
+        q.astype(jnp.float32) * scale,
+        k.astype(jnp.float32),
+        precision=_PREC,
+    )
+    if causal:
+        Tq, Tk = q.shape[1], k.shape[1]
+        qpos = jnp.arange(Tq) + (Tk - Tq)  # align ends when Tq != Tk
+        mask = jnp.arange(Tk)[None, :] <= qpos[:, None]
+        s = jnp.where(mask[None, None], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32), precision=_PREC)
+    return o.astype(q.dtype)
+
+
+def _block_accumulate(o, m, l, s, v_blk):
+    """One stable-softmax accumulation step.
+
+    o [B,H,Tq,D] f32 accumulator, m/l [B,H,Tq] running max/denominator,
+    s [B,H,Tq,Tk] masked scores (−inf allowed), v_blk [B,Tk,H,D].
+    """
+    m_new = jnp.maximum(m, s.max(axis=-1))
+    m_safe = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+    p = jnp.exp(s - m_safe[..., None])
+    p = jnp.where(jnp.isneginf(s), 0.0, p)
+    alpha = jnp.where(jnp.isneginf(m), 0.0, jnp.exp(m - m_safe))
+    o_new = alpha[..., None] * o + jnp.einsum(
+        "bhqk,bkhd->bhqd", p, v_blk.astype(jnp.float32), precision=_PREC
+    )
+    l_new = alpha * l + p.sum(axis=-1)
+    return o_new, m_new, l_new
+
+
+def ring_attention(q, k, v, axis: str, causal: bool = False):
+    """Ring attention over the named ``axis`` (call inside shard_map).
+
+    Local shapes [B, T/n, H, D]; sequence is sharded contiguously in ring
+    order (shard r holds positions [r·Tb, (r+1)·Tb)).
+    """
+    n = lax.axis_size(axis)
+    r = lax.axis_index(axis)
+    B, Tq, H, D = q.shape
+    Tb = k.shape[1]
+    scale = 1.0 / math.sqrt(D)
+    qf = q.astype(jnp.float32) * scale
+    # derive the accumulators from qf so they inherit its full varying-axes
+    # set — fori_loop requires carry input/output manual-axis types to match
+    # under shard_map, whatever axes the caller's mesh binds
+    zero_like_q = qf.transpose(0, 2, 1, 3) * 0.0  # [B,H,Tq,D]
+    o = zero_like_q
+    m = zero_like_q[..., 0] - jnp.inf
+    l = zero_like_q[..., 0]
+    qpos = r * Tq + jnp.arange(Tq)
+
+    def body(i, carry):
+        o, m, l, kc, vc = carry
+        src = (r - i) % n  # ring position the current K/V block came from
+        s = jnp.einsum("bqhd,bkhd->bhqk", qf, kc.astype(jnp.float32), precision=_PREC)
+        if causal:
+            kpos = src * Tb + jnp.arange(Tb)
+            mask = kpos[None, :] <= qpos[:, None]
+            s = jnp.where(mask[None, None], s, -jnp.inf)
+        o, m, l = _block_accumulate(o, m, l, s, vc)
+        kc = ppermute_ring(kc, axis, 1)
+        vc = ppermute_ring(vc, axis, 1)
+        return o, m, l, kc, vc
+
+    o, m, l, _, _ = lax.fori_loop(0, n, body, (o, m, l, k, v))
+    out = o / jnp.maximum(l, 1e-30)[..., None]
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)
+
+
+def ulysses_attention(q, k, v, axis: str, causal: bool = False):
+    """Ulysses (all-to-all) sequence parallelism over ``axis`` (call inside
+    shard_map).  Local shapes [B, T/n, H, D] with H % n == 0."""
+    # seq-sharded → head-sharded: each chip gets the FULL sequence of H/n heads
+    q2 = all_to_all(q, axis, split_axis=2, concat_axis=1)
+    k2 = all_to_all(k, axis, split_axis=2, concat_axis=1)
+    v2 = all_to_all(v, axis, split_axis=2, concat_axis=1)
+    o2 = attention_reference(q2, k2, v2, causal=causal)
+    # head-sharded → seq-sharded
+    return all_to_all(o2, axis, split_axis=1, concat_axis=2)
+
+
+def _seq_spec(axis: str):
+    return P(None, axis, None, None)
+
+
+def ring_attention_sharded(mesh: Mesh, q, k, v, *, axis: str = "sp", causal: bool = False):
+    """shard_map wrapper: q/k/v are global [B,T,H,D] arrays (or will be
+    sharded on entry) with T split over ``axis``."""
+    fn = shard_map(
+        functools.partial(ring_attention, axis=axis, causal=causal),
+        mesh=mesh,
+        in_specs=(_seq_spec(axis),) * 3,
+        out_specs=_seq_spec(axis),
+    )
+    return fn(q, k, v)
+
+
+def ulysses_attention_sharded(mesh: Mesh, q, k, v, *, axis: str = "sp", causal: bool = False):
+    fn = shard_map(
+        functools.partial(ulysses_attention, axis=axis, causal=causal),
+        mesh=mesh,
+        in_specs=(_seq_spec(axis),) * 3,
+        out_specs=_seq_spec(axis),
+    )
+    return fn(q, k, v)

@@ -15,6 +15,10 @@ os.environ.setdefault("JAX_ENABLE_X64", "1")
 import jax  # noqa: E402
 
 jax.config.update("jax_enable_x64", True)
+# XLA's DEFAULT matmul precision may decompose f32 matmuls into bf16 passes;
+# parity tests (sharded vs single-device) need true-f32 products so rounding
+# doesn't depend on how GSPMD partitions the contraction
+jax.config.update("jax_default_matmul_precision", "highest")
 
 import pytest  # noqa: E402
 
