@@ -288,8 +288,11 @@ class Cores:
                 if not (fl.write and not fl.read_only):
                     continue
                 if self.enqueue_mode:
-                    with self._lock:
-                        self._enqueued.append((w, p, offset, size, fl.write_all))
+                    # write_all: only the owning chip defers a readback, same
+                    # ownership rule as the immediate paths
+                    if not fl.write_all or w.index == write_all_owner.get(idx):
+                        with self._lock:
+                            self._enqueued.append((w, p, offset, size, fl.write_all))
                     continue
                 epw = fl.elements_per_work_item
                 if fl.write_all:

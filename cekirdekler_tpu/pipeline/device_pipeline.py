@@ -1,0 +1,263 @@
+"""Device→device pipeline and single-chip multi-stage pipeline.
+
+TPU-native analogue of the reference's ``ClPipeline``/``ClPipelineStage``
+(ClPipeline.cs:29-2356) and ``SingleGPUPipeline.DevicePipeline``
+(ClPipeline.cs:2357-3240): a linear graph of stages, each bound to a chip,
+all running concurrently on successive data generations; results flow
+stage→stage each ``push``.
+
+Where the reference forwards results through HOST arrays with double
+buffering (forwardResults deep-copies output→duplicate input,
+ClPipeline.cs:624-1580; switchBuffers swaps the sets, :87-111), the TPU
+build forwards device→device — ``jax.device_put`` moves the output value
+to the next stage's chip over ICI, never touching the host.  And because
+XLA arrays are immutable values, the double-buffer sets collapse to plain
+value handoff: a stage's new output cannot clobber the value the next
+stage still holds.
+
+Latency: data pushed at push t is computed by stage 0 at t, reaches stage
+k at push t+k; with S stages, ``push`` returns True (results valid) from
+push S onward (the reference's 2·stages-2 counter covers its double-init,
+ClPipeline.cs:114-122).
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass, field
+from typing import Any, Sequence
+
+import jax
+import numpy as np
+
+from ..arrays.clarray import ClArray, wrap
+from ..errors import CekirdeklerError, ComputeValidationError
+from ..hardware import Device
+from ..kernel.registry import KernelProgram
+
+__all__ = ["PipelineStage", "ClPipeline", "DevicePipeline"]
+
+
+@dataclass
+class _Slot:
+    """A logical array bound to a stage (reference: ClPipelineStageBuffer)."""
+
+    arr: ClArray
+    role: str                      # "input" | "hidden" | "output"
+    value: Any = None              # device value (jax.Array) for this stage
+
+
+class PipelineStage:
+    """One pipeline stage: kernels + device + input/hidden/output buffers
+    (reference: ClPipelineStage, ClPipeline.cs:140-1703).
+
+    Kernel argument order is inputs, then hiddens, then outputs.
+    """
+
+    def __init__(
+        self,
+        kernel_source,
+        kernels: str | Sequence[str],
+        global_range: int,
+        local_range: int = 256,
+        values: Sequence | dict = (),
+        init_kernels: str | Sequence[str] = (),
+    ):
+        self.program = KernelProgram(kernel_source)
+        self.kernels = kernels.split() if isinstance(kernels, str) else list(kernels)
+        self.init_kernels = (
+            init_kernels.split() if isinstance(init_kernels, str) else list(init_kernels)
+        )
+        self.global_range = global_range
+        self.local_range = local_range
+        self.values = values
+        self.inputs: list[_Slot] = []
+        self.hiddens: list[_Slot] = []
+        self.outputs: list[_Slot] = []
+        self.device: Device | None = None
+        self.prev: "PipelineStage | None" = None
+        self.next: "PipelineStage | None" = None
+        self.elapsed_ms = 0.0
+
+    # -- buffer binding (reference: addInput/Hidden/OutputBuffers) -----------
+    def add_input(self, *arrays, **flags) -> "PipelineStage":
+        self.inputs.extend(_Slot(wrap(a, **flags), "input") for a in arrays)
+        return self
+
+    def add_hidden(self, *arrays, **flags) -> "PipelineStage":
+        self.hiddens.extend(_Slot(wrap(a, **flags), "hidden") for a in arrays)
+        return self
+
+    def add_output(self, *arrays, **flags) -> "PipelineStage":
+        self.outputs.extend(_Slot(wrap(a, **flags), "output") for a in arrays)
+        return self
+
+    # -- graph building (reference: prependToStage/appendToStage) ------------
+    def append_to(self, prev: "PipelineStage") -> "PipelineStage":
+        prev.next, self.prev = self, prev
+        return self
+
+    def prepend_to(self, nxt: "PipelineStage") -> "PipelineStage":
+        nxt.prev, self.next = self, nxt
+        return self
+
+    # -- execution -----------------------------------------------------------
+    def _slots(self) -> list[_Slot]:
+        return self.inputs + self.hiddens + self.outputs
+
+    def _bind(self, jdev) -> None:
+        import jax.numpy as jnp
+
+        for s in self._slots():
+            if s.value is None:
+                s.value = jax.device_put(s.arr.host(), jdev)
+
+    def _run(self, kernel_names: list[str]) -> None:
+        """Launch the kernel sequence on the stage's device values."""
+        import time
+
+        t0 = time.perf_counter()
+        slots = self._slots()
+        bufs = tuple(s.value for s in slots)
+        offset = 0
+        for name in kernel_names:
+            va = (
+                self.values.get(name, ())
+                if isinstance(self.values, dict)
+                else tuple(self.values)
+            )
+            fn, _ = self.program.launcher(
+                name, self.global_range, self.local_range, self.global_range
+            )
+            n_arr = self.program.array_param_count(name)
+            out = fn(offset, bufs[:n_arr], tuple(va))
+            bufs = tuple(out) + bufs[n_arr:]
+        for s, b in zip(slots, bufs):
+            s.value = b
+        self.elapsed_ms = (time.perf_counter() - t0) * 1000.0
+
+
+class ClPipeline:
+    """Linear device→device pipeline (reference: ClPipeline,
+    ClPipeline.cs:29-139).
+
+    Build via ``ClPipeline.make(stages, devices)`` — one device per stage
+    (reference stages may span multiple devices via their own cruncher; here
+    a stage is one chip, the framework's Cores covers intra-stage
+    multi-chip).
+    """
+
+    def __init__(self, stages: list[PipelineStage]):
+        self.stages = stages
+        self.push_count = 0
+        self._pool = ThreadPoolExecutor(max_workers=max(2, len(stages)))
+
+    @classmethod
+    def make(cls, stages: Sequence[PipelineStage], devices: Sequence[Device]) -> "ClPipeline":
+        """Wire a linear pipeline onto devices and run initializer kernels
+        (reference: makePipeline + initializer double-run,
+        ClPipeline.cs:1582-1699)."""
+        stages = list(stages)
+        if not stages:
+            raise CekirdeklerError("pipeline needs at least one stage")
+        devices = list(devices)
+        if len(devices) == 1:
+            # single-chip pipeline: every stage on the one device
+            devices = devices * len(stages)
+        if len(devices) < len(stages):
+            raise CekirdeklerError(
+                f"{len(stages)} stages need {len(stages)} devices (or exactly 1 "
+                f"for a single-chip pipeline); got {len(devices)}"
+            )
+        for i, (st, d) in enumerate(zip(stages, devices)):
+            st.device = d
+            if i > 0:
+                st.prev, stages[i - 1].next = stages[i - 1], st
+            st._bind(d.jax_device)
+            for s in st._slots():
+                if s.arr.size < st.global_range:
+                    raise ComputeValidationError(
+                        f"stage {i} array '{s.arr.name}' smaller than global range"
+                    )
+        for st in stages:
+            if st.init_kernels:
+                st._run(st.init_kernels)
+        return cls(stages)
+
+    def push(
+        self,
+        data: Sequence | None = None,
+        results: Sequence | None = None,
+    ) -> bool:
+        """Advance the pipeline one generation (reference: pushData,
+        ClPipeline.cs:49-122).
+
+        ``data``: host arrays for stage 0's inputs (optional).
+        ``results``: host arrays that receive the LAST stage's outputs
+        (optional).  Returns True once results are valid (push_count ≥
+        number of stages).
+        """
+        first, last = self.stages[0], self.stages[-1]
+        if data is not None:
+            datas = list(data) if isinstance(data, (list, tuple)) else [data]
+            if len(datas) != len(first.inputs):
+                raise ComputeValidationError(
+                    f"push data count {len(datas)} != stage-0 inputs {len(first.inputs)}"
+                )
+            for slot, d in zip(first.inputs, datas):
+                host = d.host() if isinstance(d, ClArray) else np.asarray(d)
+                slot.value = jax.device_put(host, first.device.jax_device)
+
+        # all stages compute concurrently on their current values
+        futures = [self._pool.submit(st._run, st.kernels) for st in self.stages]
+        for f in futures:
+            f.result()
+
+        # read back last stage's outputs (device→host)
+        if results is not None:
+            outs = list(results) if isinstance(results, (list, tuple)) else [results]
+            for slot, r in zip(last.outputs, outs):
+                target = r.host() if isinstance(r, ClArray) else r
+                np.copyto(target, np.asarray(slot.value), casting="unsafe")
+
+        # forward outputs device→device into the next stage's inputs
+        # (ICI transfer; replaces the reference's host-hop forwardResults)
+        for st in self.stages[:-1]:
+            nxt = st.next
+            n = min(len(st.outputs), len(nxt.inputs))
+            for o_slot, i_slot in zip(st.outputs[:n], nxt.inputs[:n]):
+                i_slot.value = jax.device_put(o_slot.value, nxt.device.jax_device)
+
+        self.push_count += 1
+        return self.push_count >= len(self.stages)
+
+    def performance_report(self) -> str:
+        lines = ["pipeline stages:"]
+        for i, st in enumerate(self.stages):
+            lines.append(
+                f"  stage {i} [{st.device.name if st.device else '?'}]: "
+                f"{st.elapsed_ms:8.3f} ms  kernels={' '.join(st.kernels)}"
+            )
+        return "\n".join(lines)
+
+    def dispose(self) -> None:
+        self._pool.shutdown(wait=False)
+        for st in self.stages:
+            for s in st._slots():
+                s.value = None
+
+
+class DevicePipeline(ClPipeline):
+    """Single-chip N-stage pipeline (reference: SingleGPUPipeline.
+    DevicePipeline, ClPipeline.cs:2357-3240) — same generation semantics,
+    every stage on ONE chip; concurrency comes from XLA async dispatch
+    (replacing the reference's enqueue-mode queue rotation)."""
+
+    @classmethod
+    def make(cls, stages: Sequence[PipelineStage], device: Device) -> "DevicePipeline":
+        return super().make(stages, [device])
+
+    def feed(self, data=None, results=None) -> bool:
+        """Reference naming (feed ≙ push, ClPipeline.cs:2577-2593)."""
+        return self.push(data, results)

@@ -1,0 +1,348 @@
+"""Task pool + device pool batch scheduler.
+
+TPU-native analogue of the reference's ``Pool.*`` namespace
+(ClPipeline.cs:3241-5080): freeze compute calls into :class:`ClTask`
+objects, queue them in :class:`ClTaskPool`, and let a
+:class:`ClDevicePool` drain pools greedily — each chip runs its own
+consumer thread with a private per-chip scheduler, taking the next task
+the moment it goes idle (the reference's DEVICE_COMPUTE_AT_WILL,
+ClPipeline.cs:3792-3807).
+
+Control tasks mirror the reference's private message protocol
+(ClPipeline.cs:3247-3321):
+
+- ``DEVICE_SELECT_BEGIN(i)`` / ``DEVICE_SELECT_END`` — pin the tasks in
+  between to chip ``i``.
+- ``GLOBAL_SYNCHRONIZATION`` — barrier: everything dispatched before it
+  completes before anything after it starts.
+- ``BROADCAST`` — run the task once on EVERY chip (replicated init).
+- ``SERIAL_MODE_BEGIN`` / ``SERIAL_MODE_END`` — strict submission-order
+  execution (a barrier after every task in the span).
+
+Chips can be hot-added mid-run (reference: addDevice spawns a new
+DevicePoolThread live, ClPipeline.cs:4333-4390).
+"""
+
+from __future__ import annotations
+
+import enum
+import itertools
+import queue
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable, Sequence
+
+from ..arrays.clarray import ClArray, ParameterGroup
+from ..core.cruncher import NumberCruncher
+from ..errors import CekirdeklerError
+from ..hardware import Device, Devices
+
+__all__ = ["ClTaskType", "ClTask", "ClTaskPool", "ClDevicePool", "PoolType"]
+
+_task_ids = itertools.count(1)
+
+
+class ClTaskType(enum.Enum):
+    COMPUTE = "compute"
+    DEVICE_SELECT_BEGIN = "device_select_begin"
+    DEVICE_SELECT_END = "device_select_end"
+    GLOBAL_SYNCHRONIZATION = "global_synchronization"
+    BROADCAST = "broadcast"
+    SERIAL_MODE_BEGIN = "serial_mode_begin"
+    SERIAL_MODE_END = "serial_mode_end"
+
+
+class PoolType(enum.Enum):
+    DEVICE_COMPUTE_AT_WILL = "at_will"   # greedy (reference default)
+    # DEVICE_ROUND_ROBIN exists in the reference but is unimplemented there
+    # (ClPipeline.cs:3792-3807); we reserve the name for parity
+    DEVICE_ROUND_ROBIN = "round_robin"
+
+
+@dataclass
+class ClTask:
+    """A frozen compute call (reference: ClTask, ClPipeline.cs:3331-3520).
+
+    Built via ``array.task(...)`` / ``group.task(...)`` (ClArray.cs:1552)
+    or directly.  ``callback`` fires after completion with the task.
+    """
+
+    params: Sequence[ClArray] = ()
+    kernel_names: Sequence[str] = ()
+    compute_id: int = 0
+    global_range: int = 0
+    local_range: int = 256
+    global_offset: int = 0
+    values: Sequence | dict = ()
+    task_type: ClTaskType = ClTaskType.COMPUTE
+    select_device: int | None = None       # DEVICE_SELECT_BEGIN argument
+    callback: Callable[["ClTask"], None] | None = None
+    task_id: int = field(default_factory=lambda: next(_task_ids))
+
+    def compute(self, cruncher: NumberCruncher) -> None:
+        """Run the frozen call on the given cruncher (reference:
+        ClTask.compute, ClPipeline.cs:3386)."""
+        group = ParameterGroup(list(self.params))
+        group.compute(
+            cruncher,
+            self.compute_id,
+            list(self.kernel_names),
+            self.global_range,
+            self.local_range,
+            global_offset=self.global_offset,
+            values=self.values,
+        )
+
+    @staticmethod
+    def device_select_begin(device_index: int) -> "ClTask":
+        return ClTask(task_type=ClTaskType.DEVICE_SELECT_BEGIN, select_device=device_index)
+
+    @staticmethod
+    def device_select_end() -> "ClTask":
+        return ClTask(task_type=ClTaskType.DEVICE_SELECT_END)
+
+    @staticmethod
+    def global_synchronization() -> "ClTask":
+        return ClTask(task_type=ClTaskType.GLOBAL_SYNCHRONIZATION)
+
+    @staticmethod
+    def serial_mode_begin() -> "ClTask":
+        return ClTask(task_type=ClTaskType.SERIAL_MODE_BEGIN)
+
+    @staticmethod
+    def serial_mode_end() -> "ClTask":
+        return ClTask(task_type=ClTaskType.SERIAL_MODE_END)
+
+    def as_broadcast(self) -> "ClTask":
+        """Mark this task to run once on every chip (reference BROADCAST)."""
+        self.task_type = ClTaskType.BROADCAST
+        return self
+
+
+class ClTaskPool:
+    """Thread-safe ordered task list (reference: ClTaskPool,
+    ClPipeline.cs:3650-3790)."""
+
+    def __init__(self, tasks: Sequence[ClTask] = ()):  # noqa: D107
+        self._tasks: list[ClTask] = list(tasks)
+        self._lock = threading.Lock()
+
+    def add(self, task: ClTask) -> "ClTaskPool":
+        with self._lock:
+            self._tasks.append(task)
+        return self
+
+    def feed(self, other: "ClTaskPool") -> None:
+        """Append copies of another pool's tasks (reference: feed,
+        ClPipeline.cs:3660-3670)."""
+        with self._lock:
+            self._tasks.extend(other.snapshot())
+
+    def snapshot(self) -> list[ClTask]:
+        with self._lock:
+            return list(self._tasks)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._tasks)
+
+
+class _Consumer(threading.Thread):
+    """Per-chip consumer (reference: DevicePoolThread,
+    ClPipeline.cs:4740-5080): private cruncher, greedy pulls from the shared
+    pipe plus a pinned queue for device-selected/broadcast tasks."""
+
+    def __init__(self, pool: "ClDevicePool", device: Device, index: int):
+        super().__init__(daemon=True, name=f"devpool-{index}")
+        self.pool = pool
+        self.device = device
+        self.index = index
+        self.pinned: "queue.Queue[ClTask | None]" = queue.Queue()
+        self.cruncher = NumberCruncher(Devices([device]), pool.kernel_source)
+        self.tasks_done = 0
+        self._halt = False
+
+    def run(self) -> None:  # pragma: no cover - exercised via pool tests
+        while not self._halt:
+            # claim up to max_queues_per_device tasks per wake (the
+            # reference's per-device queue depth, ClPipeline.cs:3933-3980)
+            # and run them back-to-back
+            batch: list[ClTask] = []
+            try:
+                batch.append(self.pinned.get_nowait())
+            except queue.Empty:
+                try:
+                    batch.append(self.pool._pipe.get(timeout=0.05))
+                except queue.Empty:
+                    continue
+            while len(batch) < self.pool.max_queues_per_device:
+                try:
+                    batch.append(self.pool._pipe.get_nowait())
+                except queue.Empty:
+                    break
+            for task in batch:
+                try:
+                    task.compute(self.cruncher)
+                    self.tasks_done += 1
+                    if task.callback is not None:
+                        task.callback(task)
+                except Exception as e:  # surface through the pool
+                    self.pool._errors.append(e)
+                finally:
+                    self.pool._done_one()
+
+    def stop(self) -> None:
+        self._halt = True
+
+
+class ClDevicePool:
+    """Greedy batch scheduler over chips (reference: ClDevicePool,
+    ClPipeline.cs:3933-4737).
+
+    One consumer thread + private single-chip :class:`NumberCruncher` per
+    device; a producer thread walks enqueued task pools, interprets control
+    tasks, and pushes compute tasks to the shared pipe.
+    """
+
+    def __init__(
+        self,
+        devices: Devices,
+        kernel_source,
+        pool_type: PoolType = PoolType.DEVICE_COMPUTE_AT_WILL,
+        max_queues_per_device: int = 4,
+    ):
+        if pool_type is not PoolType.DEVICE_COMPUTE_AT_WILL:
+            raise CekirdeklerError(
+                "only DEVICE_COMPUTE_AT_WILL is implemented (the reference's "
+                "ROUND_ROBIN is unimplemented there too, ClPipeline.cs:3792-3807)"
+            )
+        self.kernel_source = kernel_source
+        self.max_queues_per_device = max_queues_per_device
+        self._pipe: "queue.Queue[ClTask]" = queue.Queue()
+        self._pools: "queue.Queue[ClTaskPool | None]" = queue.Queue()
+        self._errors: list[Exception] = []
+        self._inflight = 0
+        self._inflight_lock = threading.Condition()
+        self._consumers: list[_Consumer] = []
+        self._consumers_lock = threading.Lock()
+        for d in devices:
+            self._add_consumer(d)
+        self._producer = threading.Thread(target=self._produce, daemon=True, name="devpool-producer")
+        self._running = True
+        self._producer.start()
+
+    # -- device management ---------------------------------------------------
+    def _add_consumer(self, device: Device) -> None:
+        c = _Consumer(self, device, len(self._consumers))
+        self._consumers.append(c)
+        c.start()
+
+    def add_device(self, device: Device) -> None:
+        """Hot-add a chip mid-run (reference: ClPipeline.cs:4333-4390)."""
+        with self._consumers_lock:
+            self._add_consumer(device)
+
+    @property
+    def num_devices(self) -> int:
+        return len(self._consumers)
+
+    def tasks_done_per_device(self) -> list[int]:
+        return [c.tasks_done for c in self._consumers]
+
+    # -- accounting ----------------------------------------------------------
+    def _dispatch_one(self) -> None:
+        with self._inflight_lock:
+            self._inflight += 1
+
+    def _done_one(self) -> None:
+        with self._inflight_lock:
+            self._inflight -= 1
+            self._inflight_lock.notify_all()
+
+    def _drain(self) -> None:
+        with self._inflight_lock:
+            while self._inflight > 0:
+                self._inflight_lock.wait(timeout=0.5)
+
+    # -- producer ------------------------------------------------------------
+    def _produce(self) -> None:  # pragma: no cover - exercised via tests
+        while self._running:
+            try:
+                pool = self._pools.get(timeout=0.05)
+            except queue.Empty:
+                continue
+            if pool is None:
+                continue
+            selected: int | None = None
+            serial = False
+            for task in pool.snapshot():
+                tt = task.task_type
+                if tt is ClTaskType.DEVICE_SELECT_BEGIN:
+                    selected = task.select_device
+                    continue
+                if tt is ClTaskType.DEVICE_SELECT_END:
+                    selected = None
+                    continue
+                if tt is ClTaskType.GLOBAL_SYNCHRONIZATION:
+                    self._drain()
+                    continue
+                if tt is ClTaskType.SERIAL_MODE_BEGIN:
+                    serial = True
+                    continue
+                if tt is ClTaskType.SERIAL_MODE_END:
+                    serial = False
+                    continue
+                if tt is ClTaskType.BROADCAST:
+                    with self._consumers_lock:
+                        targets = list(self._consumers)
+                    for c in targets:
+                        self._dispatch_one()
+                        c.pinned.put(task)
+                    self._drain()
+                    continue
+                # plain compute
+                self._dispatch_one()
+                if selected is not None:
+                    with self._consumers_lock:
+                        if not (0 <= selected < len(self._consumers)):
+                            self._done_one()
+                            self._errors.append(
+                                CekirdeklerError(f"device_select index {selected} out of range")
+                            )
+                            continue
+                        self._consumers[selected].pinned.put(task)
+                else:
+                    self._pipe.put(task)
+                if serial:
+                    self._drain()
+            self._pools.task_done()
+
+    # -- public API ----------------------------------------------------------
+    def enqueue_task_pool(self, pool: ClTaskPool) -> None:
+        """Queue a pool for execution (reference: enqueueTaskPool,
+        ClPipeline.cs:4400-4409)."""
+        self._pools.put(pool)
+
+    def finish(self) -> None:
+        """Block until all enqueued pools are fully executed (reference:
+        finish, ClPipeline.cs:4433+)."""
+        self._pools.join()
+        self._drain()
+        if self._errors:
+            errs, self._errors = self._errors, []
+            raise errs[0]
+
+    def dispose(self) -> None:
+        self._running = False
+        for c in self._consumers:
+            c.stop()
+        for c in self._consumers:
+            c.join(timeout=2.0)
+        for c in self._consumers:
+            c.cruncher.dispose()
+
+    def __enter__(self) -> "ClDevicePool":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.dispose()

@@ -1,0 +1,150 @@
+"""Task/device pool scheduler tests (reference semantics:
+ClPipeline.cs:3241-5080) on the 8-virtual-device rig."""
+
+import threading
+
+import numpy as np
+import pytest
+
+import cekirdekler_tpu as ct
+from cekirdekler_tpu.arrays.clarray import ClArray
+from cekirdekler_tpu.pipeline.pool import ClDevicePool, ClTask, ClTaskPool, PoolType
+
+SRC = """
+__kernel void addOne(__global float* a) {
+    int i = get_global_id(0);
+    a[i] = a[i] + 1.0f;
+}
+__kernel void scale2(__global float* a) {
+    int i = get_global_id(0);
+    a[i] = a[i] * 2.0f;
+}
+"""
+
+
+def _cpus(n=4):
+    return ct.all_devices().cpus().subset(n)
+
+
+def _task(arr, kernel, cid):
+    return ClTask(
+        params=[arr], kernel_names=[kernel], compute_id=cid,
+        global_range=arr.size, local_range=64,
+    )
+
+
+def test_pool_runs_all_tasks_greedily():
+    n = 256
+    arrays = [ClArray(np.zeros(n, np.float32)) for _ in range(12)]
+    pool = ClTaskPool()
+    for i, a in enumerate(arrays):
+        pool.add(_task(a, "addOne", 100 + i))
+    with ClDevicePool(_cpus(4), SRC) as dp:
+        dp.enqueue_task_pool(pool)
+        dp.finish()
+        done = dp.tasks_done_per_device()
+    assert sum(done) == 12
+    for a in arrays:
+        np.testing.assert_array_equal(a.host(), np.ones(n, np.float32))
+
+
+def test_device_select_pins_tasks():
+    n = 128
+    arrays = [ClArray(np.zeros(n, np.float32)) for _ in range(4)]
+    pool = ClTaskPool()
+    pool.add(ClTask.device_select_begin(1))
+    for i, a in enumerate(arrays):
+        pool.add(_task(a, "addOne", 200 + i))
+    pool.add(ClTask.device_select_end())
+    with ClDevicePool(_cpus(3), SRC) as dp:
+        dp.enqueue_task_pool(pool)
+        dp.finish()
+        done = dp.tasks_done_per_device()
+    assert done == [0, 4, 0]
+
+
+def test_global_synchronization_orders_phases():
+    """addOne on every array, global sync, then scale2: result must be
+    (0+1)*2 = 2 everywhere — without the barrier a scale2 could run before
+    its addOne."""
+    n = 128
+    a = ClArray(np.zeros(n, np.float32))
+    pool = ClTaskPool()
+    pool.add(_task(a, "addOne", 300))
+    pool.add(ClTask.global_synchronization())
+    pool.add(_task(a, "scale2", 301))
+    with ClDevicePool(_cpus(2), SRC) as dp:
+        dp.enqueue_task_pool(pool)
+        dp.finish()
+    np.testing.assert_array_equal(a.host(), np.full(n, 2.0, np.float32))
+
+
+def test_broadcast_runs_on_every_device():
+    n = 64
+    a = ClArray(np.zeros(n, np.float32))
+    counter = []
+    t = _task(a, "addOne", 400).as_broadcast()
+    t.callback = lambda task: counter.append(1)
+    with ClDevicePool(_cpus(3), SRC) as dp:
+        dp.enqueue_task_pool(ClTaskPool([t]))
+        dp.finish()
+        done = dp.tasks_done_per_device()
+    assert done == [1, 1, 1]
+    assert len(counter) == 3
+
+
+def test_serial_mode_executes_in_order():
+    n = 64
+    a = ClArray(np.zeros(n, np.float32))
+    order = []
+    pool = ClTaskPool()
+    pool.add(ClTask.serial_mode_begin())
+    for i in range(6):
+        kernel = "addOne" if i % 2 == 0 else "scale2"
+        t = _task(a, kernel, 500 + i)
+        t.callback = lambda task, i=i: order.append(i)
+        pool.add(t)
+    pool.add(ClTask.serial_mode_end())
+    with ClDevicePool(_cpus(3), SRC) as dp:
+        dp.enqueue_task_pool(pool)
+        dp.finish()
+    assert order == list(range(6))
+    # ((((0+1)*2)+1)*2+1)*2 = 14
+    np.testing.assert_array_equal(a.host(), np.full(n, 14.0, np.float32))
+
+
+def test_hot_add_device():
+    n = 128
+    arrays = [ClArray(np.zeros(n, np.float32)) for _ in range(8)]
+    pool = ClTaskPool()
+    for i, a in enumerate(arrays):
+        pool.add(_task(a, "addOne", 600 + i))
+    with ClDevicePool(_cpus(1), SRC) as dp:
+        dp.add_device(ct.all_devices().cpus()[1])
+        assert dp.num_devices == 2
+        dp.enqueue_task_pool(pool)
+        dp.finish()
+        assert sum(dp.tasks_done_per_device()) == 8
+    for a in arrays:
+        np.testing.assert_array_equal(a.host(), np.ones(n, np.float32))
+
+
+def test_round_robin_rejected():
+    with pytest.raises(Exception):
+        ClDevicePool(_cpus(1), SRC, pool_type=PoolType.DEVICE_ROUND_ROBIN)
+
+
+def test_callbacks_and_errors_surface():
+    bad = ClTask(params=[ClArray(np.zeros(64, np.float32))],
+                 kernel_names=["nope"], compute_id=700, global_range=64, local_range=64)
+    with ClDevicePool(_cpus(1), SRC) as dp:
+        dp.enqueue_task_pool(ClTaskPool([bad]))
+        with pytest.raises(Exception):
+            dp.finish()
+
+
+def test_task_factory_from_array():
+    a = ClArray(np.zeros(64, np.float32))
+    t = a.task(800, "addOne", 64, 64)
+    assert t.kernel_names == ["addOne"]
+    assert t.global_range == 64

@@ -98,6 +98,28 @@ def test_enqueue_mode_with_pipeline_flushes_correctly():
         cr.dispose()
 
 
+def test_enqueue_write_all_single_owner_readback():
+    """Regression: under enqueue mode only the owning chip defers a
+    write_all readback — N racing full-array downloads are wrong and
+    wasteful."""
+    from cekirdekler_tpu.arrays.clarray import ClArray
+    from cekirdekler_tpu.core.cruncher import NumberCruncher
+
+    n = 512
+    out = ClArray(np.zeros(n, np.float32), read=False, write=True, write_all=True)
+    cr = NumberCruncher(
+        _cpus().subset(4),
+        "__kernel void f(__global float* o){ int i=get_global_id(0); o[i]=o[i]+1.0f; }",
+    )
+    try:
+        cr.enqueue_mode = True
+        out.compute(cr, 3, "f", n, 64)
+        assert len(cr.cores._enqueued) == 1  # one owner, one deferred record
+        cr.enqueue_mode = False
+    finally:
+        cr.dispose()
+
+
 def test_partial_range_readback_preserves_host_outside_range():
     """Regression: a single-device compute over a prefix of the array must
     not overwrite host elements beyond the computed range."""
