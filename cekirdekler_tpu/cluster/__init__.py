@@ -1,0 +1,28 @@
+"""Cluster tier: multi-node compute over TCP (reference L6,
+SURVEY.md §2.1 #11-16).
+
+For TPU pods the idiomatic multi-host path is one JAX distributed runtime
+spanning hosts (parallel/ meshes over DCN); this tier reproduces the
+reference's explicit node orchestration — a :class:`ClusterAccelerator`
+driving :class:`CruncherServer` nodes through the :class:`CruncherClient`
+wire protocol — for parity and for heterogeneous/ad-hoc fleets.
+"""
+
+from .accelerator import ClusterAccelerator, IComputeNode
+from .balancer import ClusterLoadBalancer
+from .client import CruncherClient
+from .netbuffer import ArrayRecord, Command, Message, recv_message, send_message
+from .server import CruncherServer
+
+__all__ = [
+    "ArrayRecord",
+    "ClusterAccelerator",
+    "ClusterLoadBalancer",
+    "Command",
+    "CruncherClient",
+    "CruncherServer",
+    "IComputeNode",
+    "Message",
+    "recv_message",
+    "send_message",
+]

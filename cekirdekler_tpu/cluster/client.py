@@ -1,0 +1,151 @@
+"""Cluster compute client: drives one remote server node.
+
+TPU-native analogue of ``ClCruncherClient`` (ClCruncherClient.cs):
+``setup`` ships the kernel source (:121-155); ``compute`` marshals the
+node's share of ranges + the needed array regions, blocks on the reply,
+and writes returned slices back into the caller's host arrays (:156-259);
+``control``/``num_devices``/``stop`` mirror the management surface
+(:260-325).
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+
+import numpy as np
+
+from ..arrays.clarray import ClArray
+from ..errors import CekirdeklerError
+from .netbuffer import (
+    FLAG_PARTIAL,
+    FLAG_READ,
+    FLAG_WRITE,
+    FLAG_WRITE_ALL,
+    ArrayRecord,
+    Command,
+    Message,
+    recv_message,
+    send_message,
+)
+
+__all__ = ["CruncherClient"]
+
+
+def _flags_of(arr: ClArray) -> int:
+    fl = arr.flags
+    out = 0
+    if fl.read and not fl.write_only:
+        out |= FLAG_READ
+    if fl.partial_read:
+        out |= FLAG_PARTIAL
+    if fl.write and not fl.read_only:
+        out |= FLAG_WRITE
+    if fl.write_all:
+        out |= FLAG_WRITE_ALL
+    return out
+
+
+class CruncherClient:
+    """Synchronous request/reply client of one compute node."""
+
+    def __init__(self, host: str, port: int, timeout: float = 30.0):
+        self.sock = socket.create_connection((host, port), timeout=timeout)
+        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._lock = threading.Lock()
+        self.remote_devices = 0
+
+    def _roundtrip(self, msg: Message) -> Message:
+        with self._lock:
+            send_message(self.sock, msg)
+            reply = recv_message(self.sock)
+        if reply.command == Command.ANSWER_ERROR:
+            raise CekirdeklerError(f"remote error: {reply.strings and reply.strings[0]}")
+        return reply
+
+    def setup(self, kernel_source: str, max_devices: int = 0) -> int:
+        reply = self._roundtrip(
+            Message(
+                Command.SETUP,
+                meta={"max_devices": max_devices},
+                strings=[kernel_source],
+            )
+        )
+        self.remote_devices = reply.meta.get("n", 0)
+        return self.remote_devices
+
+    def compute(
+        self,
+        kernel_names: list[str],
+        params: list[ClArray],
+        compute_id: int,
+        global_offset: int,
+        global_range: int,
+        local_range: int,
+        values=(),
+    ) -> None:
+        """Run this node's share [global_offset, global_offset+global_range)
+        remotely; blocks and writes results back into ``params``."""
+        msg = Message(
+            Command.COMPUTE,
+            meta={
+                "compute_id": compute_id,
+                "global_offset": global_offset,
+                "global_range": global_range,
+                "local_range": local_range,
+            },
+            strings=list(kernel_names),
+            values=list(values),
+        )
+        for p in params:
+            flags = _flags_of(p)
+            aid = id(p)
+            msg.meta[f"size_{aid}"] = p.size
+            host = p.host()
+            if flags & FLAG_READ:
+                if flags & FLAG_PARTIAL:
+                    epw = p.flags.elements_per_work_item
+                    lo, hi = global_offset * epw, (global_offset + global_range) * epw
+                    data, off = host[lo:hi], lo
+                else:
+                    data, off = host, 0
+            else:
+                data, off = host[:0], 0
+            msg.arrays.append(
+                ArrayRecord(aid, data, flags, p.flags.elements_per_work_item, off)
+            )
+        reply = self._roundtrip(msg)
+        by_id = {id(p): p for p in params}
+        for rec in reply.arrays:
+            arr = by_id.get(rec.array_id)
+            if arr is None:
+                continue
+            arr.host()[rec.offset : rec.offset + rec.data.size] = rec.data
+
+    def control(self) -> bool:
+        """Liveness ping (reference: control, ClCruncherClient.cs:275)."""
+        try:
+            return self._roundtrip(Message(Command.CONTROL)).command == Command.ANSWER_CONTROL
+        except (CekirdeklerError, OSError, ConnectionError):
+            return False
+
+    def num_devices(self) -> int:
+        return self._roundtrip(Message(Command.NUM_DEVICES)).meta.get("n", 0)
+
+    def dispose_remote(self) -> None:
+        try:
+            send_message(self.sock, Message(Command.DISPOSE))
+        except OSError:
+            pass
+
+    def stop_server(self) -> None:
+        try:
+            send_message(self.sock, Message(Command.SERVER_STOP))
+        except OSError:
+            pass
+
+    def close(self) -> None:
+        try:
+            self.sock.close()
+        except OSError:
+            pass

@@ -1,0 +1,212 @@
+"""Cluster compute server: one TCP listener, one thread per client.
+
+TPU-native analogue of ``ClCruncherServer(+Thread)`` (ClCruncherServer.cs,
+ClCruncherServerThread.cs): SETUP builds a local :class:`NumberCruncher`
+from the kernel source (ClCruncherServerThread.cs:113-146); COMPUTE
+unmarshals kernel names / ranges / arrays, runs the local multi-chip
+scheduler over the node's share of the global range, and returns the
+written slices (:147-250); CONTROL answers pings; NUM_DEVICES reports the
+node's chip count; DISPOSE tears the cruncher down; SERVER_STOP ends the
+server.  Array identity across calls rides client-side ids cached per
+connection (:175-185) so repeated computes reuse device buffers.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+
+import numpy as np
+
+from ..arrays.clarray import ClArray
+from ..core.cruncher import NumberCruncher
+from ..hardware import all_devices
+from .netbuffer import (
+    FLAG_PARTIAL,
+    FLAG_READ,
+    FLAG_WRITE,
+    FLAG_WRITE_ALL,
+    ArrayRecord,
+    Command,
+    Message,
+    recv_message,
+    send_message,
+)
+
+__all__ = ["CruncherServer"]
+
+
+class _ClientSession(threading.Thread):
+    """Per-connection state + dispatch loop (reference:
+    ClCruncherServerThread)."""
+
+    def __init__(self, server: "CruncherServer", conn: socket.socket, addr):
+        super().__init__(daemon=True, name=f"cruncher-client-{addr}")
+        self.server = server
+        self.conn = conn
+        self.cruncher: NumberCruncher | None = None
+        self.arrays: dict[int, ClArray] = {}  # client array id → local array
+
+    def run(self) -> None:  # pragma: no cover - driven by tests via sockets
+        try:
+            while True:
+                msg = recv_message(self.conn)
+                if msg.command == Command.SETUP:
+                    self._setup(msg)
+                elif msg.command == Command.COMPUTE:
+                    self._compute(msg)
+                elif msg.command == Command.CONTROL:
+                    send_message(self.conn, Message(Command.ANSWER_CONTROL))
+                elif msg.command == Command.NUM_DEVICES:
+                    n = self.cruncher.num_devices if self.cruncher else len(
+                        self.server.devices
+                    )
+                    send_message(
+                        self.conn,
+                        Message(Command.ANSWER_NUM_DEVICES, meta={"n": n}),
+                    )
+                elif msg.command == Command.DISPOSE:
+                    self._dispose()
+                elif msg.command == Command.SERVER_STOP:
+                    self.server.stop()
+                    break
+                else:
+                    send_message(
+                        self.conn,
+                        Message(Command.ANSWER_ERROR, strings=[f"bad command {msg.command}"]),
+                    )
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            self._dispose()
+            try:
+                self.conn.close()
+            except OSError:
+                pass
+
+    def _setup(self, msg: Message) -> None:
+        try:
+            source = msg.strings[0]
+            max_devices = msg.meta.get("max_devices", 0)
+            devices = self.server.devices
+            if max_devices > 0:
+                devices = devices.subset(max_devices)
+            self._dispose()
+            self.cruncher = NumberCruncher(devices, source)
+            send_message(
+                self.conn,
+                Message(Command.ANSWER_SETUP, meta={"n": self.cruncher.num_devices}),
+            )
+        except Exception as e:
+            send_message(self.conn, Message(Command.ANSWER_ERROR, strings=[str(e)]))
+
+    def _compute(self, msg: Message) -> None:
+        try:
+            if self.cruncher is None:
+                raise RuntimeError("COMPUTE before SETUP")
+            kernels = msg.strings
+            cid = msg.meta["compute_id"]
+            goff = msg.meta["global_offset"]
+            grange = msg.meta["global_range"]
+            lrange = msg.meta["local_range"]
+            params: list[ClArray] = []
+            for rec in msg.arrays:
+                arr = self.arrays.get(rec.array_id)
+                total = msg.meta[f"size_{rec.array_id}"]
+                if arr is None or arr.size != total or arr.dtype != rec.data.dtype:
+                    arr = ClArray(np.zeros(total, rec.data.dtype))
+                    self.arrays[rec.array_id] = arr
+                if rec.flags & FLAG_READ and rec.data.size:
+                    arr.host()[rec.offset : rec.offset + rec.data.size] = rec.data
+                arr.flags.read = bool(rec.flags & FLAG_READ)
+                arr.flags.partial_read = bool(rec.flags & FLAG_PARTIAL)
+                arr.flags.write = bool(rec.flags & FLAG_WRITE)
+                arr.flags.write_all = bool(rec.flags & FLAG_WRITE_ALL)
+                arr.flags.elements_per_work_item = rec.epw
+                params.append(arr)
+            from ..arrays.clarray import ParameterGroup
+
+            group = ParameterGroup(params)
+            group.compute(
+                self.cruncher, cid, kernels, grange, lrange,
+                global_offset=goff, values=tuple(msg.values),
+            )
+            # return written slices: this node's [goff, goff+grange) × epw
+            reply = Message(Command.ANSWER_COMPUTE, meta={"compute_id": cid})
+            for rec, arr in zip(msg.arrays, params):
+                if not (rec.flags & FLAG_WRITE):
+                    continue
+                if rec.flags & FLAG_WRITE_ALL:
+                    # cluster-level single-owner rule: remote nodes never
+                    # return write_all arrays (the mainframe owns them) —
+                    # else N nodes race full-array writebacks on the client
+                    continue
+                else:
+                    epw = rec.epw
+                    lo, hi = goff * epw, (goff + grange) * epw
+                reply.arrays.append(
+                    ArrayRecord(
+                        rec.array_id, arr.host()[lo:hi], rec.flags, rec.epw, lo
+                    )
+                )
+            send_message(self.conn, reply)
+        except Exception as e:
+            send_message(self.conn, Message(Command.ANSWER_ERROR, strings=[str(e)]))
+
+    def _dispose(self) -> None:
+        if self.cruncher is not None:
+            self.cruncher.dispose()
+            self.cruncher = None
+        self.arrays.clear()
+
+
+class CruncherServer:
+    """TCP compute node (reference: ClCruncherServer.cs:56-133)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0, devices=None):
+        self.devices = devices if devices is not None else all_devices()
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(16)
+        self.host, self.port = self._sock.getsockname()
+        self._running = True
+        self._sessions: list[_ClientSession] = []
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True, name="cruncher-server"
+        )
+        self._accept_thread.start()
+
+    def _accept_loop(self) -> None:  # pragma: no cover - exercised via tests
+        while self._running:
+            try:
+                conn, addr = self._sock.accept()
+            except OSError:
+                break
+            self._sessions = [s for s in self._sessions if s.is_alive()]
+            session = _ClientSession(self, conn, addr)
+            self._sessions.append(session)
+            session.start()
+
+    def stop(self) -> None:
+        self._running = False
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        # close live sessions: unblocks their recv loops, whose finally
+        # blocks dispose crunchers (device buffers) and close sockets
+        for session in self._sessions:
+            try:
+                session.conn.close()
+            except OSError:
+                pass
+        for session in self._sessions:
+            session.join(timeout=2.0)
+        self._sessions.clear()
+
+    def __enter__(self) -> "CruncherServer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

@@ -217,6 +217,10 @@ class ClPipeline:
         # read back last stage's outputs (device→host)
         if results is not None:
             outs = list(results) if isinstance(results, (list, tuple)) else [results]
+            if len(outs) != len(last.outputs):
+                raise ComputeValidationError(
+                    f"results count {len(outs)} != last-stage outputs {len(last.outputs)}"
+                )
             for slot, r in zip(last.outputs, outs):
                 target = r.host() if isinstance(r, ClArray) else r
                 np.copyto(target, np.asarray(slot.value), casting="unsafe")

@@ -219,7 +219,7 @@ class ClDevicePool:
         self.kernel_source = kernel_source
         self.max_queues_per_device = max_queues_per_device
         self._pipe: "queue.Queue[ClTask]" = queue.Queue()
-        self._pools: "queue.Queue[ClTaskPool | None]" = queue.Queue()
+        self._pools: "queue.Queue[ClTaskPool]" = queue.Queue()
         self._errors: list[Exception] = []
         self._inflight = 0
         self._inflight_lock = threading.Condition()
@@ -270,8 +270,6 @@ class ClDevicePool:
             try:
                 pool = self._pools.get(timeout=0.05)
             except queue.Empty:
-                continue
-            if pool is None:
                 continue
             selected: int | None = None
             serial = False

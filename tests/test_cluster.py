@@ -1,0 +1,155 @@
+"""Cluster tier tests: wire format round-trip, balancer math, and a real
+localhost cluster (2 server nodes + mainframe) computing correctly."""
+
+import numpy as np
+import pytest
+
+import cekirdekler_tpu as ct
+from cekirdekler_tpu.arrays.clarray import ClArray
+from cekirdekler_tpu.cluster import (
+    ClusterAccelerator,
+    ClusterLoadBalancer,
+    Command,
+    CruncherClient,
+    CruncherServer,
+    Message,
+)
+from cekirdekler_tpu.cluster.netbuffer import ArrayRecord
+
+SRC = """
+__kernel void saxpy(__global float* x, __global float* y, float a) {
+    int i = get_global_id(0);
+    y[i] = y[i] + a * x[i];
+}
+"""
+
+
+def _cpus(n):
+    return ct.all_devices().cpus().subset(n)
+
+
+# -- wire format -------------------------------------------------------------
+
+def test_message_roundtrip():
+    data = np.arange(10, dtype=np.float32)
+    msg = Message(
+        Command.COMPUTE,
+        meta={"compute_id": 7, "global_range": 1024},
+        strings=["saxpy", "k2"],
+        values=[3, 2.5],
+        arrays=[ArrayRecord(42, data, flags=5, epw=2, offset=4)],
+    )
+    decoded = Message.decode(msg.command, msg.encode())
+    assert decoded.meta == msg.meta
+    assert decoded.strings == ["saxpy", "k2"]
+    assert decoded.values == [3, 2.5]
+    rec = decoded.arrays[0]
+    assert (rec.array_id, rec.flags, rec.epw, rec.offset) == (42, 5, 2, 4)
+    np.testing.assert_array_equal(rec.data, data)
+
+
+# -- cluster balancer --------------------------------------------------------
+
+def test_cluster_balancer_equal_split_lcm_units():
+    bal = ClusterLoadBalancer(steps=[256, 512])
+    ranges, rem = bal.equal_split(4096)
+    assert sum(ranges) + rem == 4096
+    assert all(r % 512 == 0 for r in ranges)  # LCM(256,512)=512 chunks
+
+
+def test_cluster_balancer_rebalance_moves_toward_fast_node():
+    bal = ClusterLoadBalancer(steps=[64, 64])
+    ranges, rem = bal.equal_split(2048)
+    start = list(ranges)
+    # node 0 is 4x faster
+    for _ in range(8):
+        ranges, rem = bal.rebalance(ranges, [10.0, 40.0], 2048)
+    assert ranges[0] > start[0]
+    assert ranges[0] % 64 == 0 and ranges[1] % 64 == 0
+    assert sum(ranges) + rem == 2048
+
+
+# -- live localhost cluster --------------------------------------------------
+
+@pytest.fixture()
+def two_servers():
+    s1 = CruncherServer(devices=_cpus(2))
+    s2 = CruncherServer(devices=_cpus(2))
+    yield s1, s2
+    s1.stop()
+    s2.stop()
+
+
+def test_client_setup_control_numdevices(two_servers):
+    s1, _ = two_servers
+    c = CruncherClient(s1.host, s1.port)
+    assert c.setup(SRC) == 2
+    assert c.control()
+    assert c.num_devices() == 2
+    c.close()
+
+
+def test_cluster_compute_matches_host(two_servers):
+    s1, s2 = two_servers
+    n = 4096
+    x = ClArray(np.arange(n, dtype=np.float32), partial_read=True, read_only=True)
+    y = ClArray(np.ones(n, np.float32), partial_read=True)
+    cluster = ClusterAccelerator(
+        [(s1.host, s1.port), (s2.host, s2.port)], local_devices=_cpus(2)
+    )
+    try:
+        cluster.setup_nodes(SRC)
+        for it in range(3):
+            want = y.host() + 2.0 * x.host()
+            cluster.compute("saxpy", [x, y], 900, n, 64, values=(2.0,))
+            np.testing.assert_allclose(y.host(), want, rtol=1e-6)
+        shares = cluster.ranges_of(900)
+        assert sum(shares) == n
+        assert len(shares) == 3  # 2 remote nodes + mainframe
+        assert len(cluster.compute_timing(900)) == 3
+    finally:
+        cluster.dispose()
+
+
+def test_cluster_write_all_owned_by_mainframe(two_servers):
+    """write_all arrays come back from the mainframe only — remote nodes
+    must not race full-array writebacks."""
+    s1, s2 = two_servers
+    n = 1024
+    out = ClArray(np.zeros(n, np.float32), read=False, write=True, write_all=True)
+    cluster = ClusterAccelerator(
+        [(s1.host, s1.port), (s2.host, s2.port)], local_devices=_cpus(2)
+    )
+    try:
+        # write_all semantics: the kernel writes the WHOLE array regardless
+        # of its assigned range; exactly one owner copy must win
+        cluster.setup_nodes(
+            "__kernel void fill(__global float* o, int n)"
+            "{ for (int j = 0; j < n; j++) { o[j] = 5.0f; } }"
+        )
+        cluster.compute("fill", [out], 901, n, 64, values=(n,))
+        # the mainframe's chips wrote the whole array: every element set
+        np.testing.assert_array_equal(out.host(), np.full(n, 5.0, np.float32))
+    finally:
+        cluster.dispose()
+
+
+def test_cluster_balancer_starved_node_recovers():
+    bal = ClusterLoadBalancer(steps=[64, 64])
+    ranges, rem = bal.equal_split(2048)
+    # drive node 1 to its floor with terrible times, then make it fast
+    for _ in range(12):
+        ranges, rem = bal.rebalance(ranges, [1.0, 1000.0], 2048)
+    assert ranges[1] >= 64  # probe share survives
+    for _ in range(12):
+        ranges, rem = bal.rebalance(ranges, [1000.0, 1.0], 2048)
+    assert ranges[1] > 512  # starved node earned its work back
+
+
+def test_probe_finds_live_servers(two_servers):
+    s1, s2 = two_servers
+    live = ClusterAccelerator.probe(
+        [(s1.host, s1.port), ("127.0.0.1", 1), (s2.host, s2.port)], timeout=0.3
+    )
+    assert (s1.host, s1.port) in live and (s2.host, s2.port) in live
+    assert ("127.0.0.1", 1) not in live
