@@ -29,16 +29,20 @@ def main() -> None:
     width = height = 2048
     max_iter = 256
 
-    # Baseline: single chip, no pipelining (plain H2D→launch→D2H each call).
+    # Baseline: the naive unscheduled loop — kernel-language program on one
+    # chip, full image D2H + host sync every iteration (what a user gets
+    # without the framework's enqueue/overlap machinery).
     base = run_mandelbrot(
         devs.subset(1), width=width, height=height, max_iter=max_iter,
         iters=6, warmup=2, pipeline=False,
     )
 
-    # Framework path: every chip, blob-pipelined overlap + load balancer.
+    # Framework path: hand-tiled Pallas kernel through the same compute()
+    # scheduler, enqueue mode keeps the image in HBM (one flush at the end),
+    # 16-deep dispatch chains amortize sync latency.
     full = run_mandelbrot(
         devs, width=width, height=height, max_iter=max_iter,
-        iters=10, warmup=3, pipeline=True, pipeline_blobs=8,
+        iters=32, warmup=4, use_pallas=True, readback="final", sync_every=16,
     )
 
     result = {

@@ -70,6 +70,7 @@ class ClusterAccelerator(IComputeNode):
         self.balancers: dict[int, ClusterLoadBalancer] = {}
         self.ranges: dict[int, list[int]] = {}     # per node (clients..., mainframe)
         self.timings: dict[int, list[float]] = {}
+        self._shadows: dict[int, ClArray] = {}     # mainframe read-array shadows
         self._pool = ThreadPoolExecutor(max_workers=max(2, len(self.clients) + 1))
 
     @staticmethod
@@ -146,12 +147,23 @@ class ClusterAccelerator(IComputeNode):
             acc += r
         self.ranges[compute_id] = shares
 
+        # consistent input snapshot, taken on this thread BEFORE any node
+        # starts writing results back — concurrent writebacks must not tear
+        # the view another node's payload is marshaled from
+        import numpy as np
+
+        def eff_read(p: ClArray) -> bool:
+            return p.flags.read and not p.flags.write_only
+
+        snapshot = {id(p): p.host().copy() for p in params if eff_read(p)}
+
         def run_client(i: int):
             if shares[i] <= 0:
                 return 0.0
             t0 = time.perf_counter()
             self.clients[i].compute(
-                names, params, compute_id, refs[i], shares[i], local_range, values
+                names, params, compute_id, refs[i], shares[i], local_range,
+                values, snapshot=snapshot,
             )
             return (time.perf_counter() - t0) * 1000.0
 
@@ -160,11 +172,38 @@ class ClusterAccelerator(IComputeNode):
             if shares[i] <= 0:
                 return 0.0
             t0 = time.perf_counter()
-            group = ParameterGroup(params)
+            # the mainframe computes on shadows of read arrays (its own
+            # copies of the snapshot), then copies its written ranges back —
+            # reading live host arrays would race client writebacks
+            shadows: list[ClArray] = []
+            for p in params:
+                if eff_read(p):
+                    # reuse one shadow per user array: the mainframe worker
+                    # caches device buffers by array identity
+                    sh = self._shadows.get(id(p))
+                    if sh is None or sh.size != p.size or sh.dtype != p.dtype:
+                        sh = ClArray(snapshot[id(p)].copy(), name=p.name)
+                        self._shadows[id(p)] = sh
+                    else:
+                        np.copyto(sh.host(), snapshot[id(p)])
+                    sh.flags = p.flags
+                    shadows.append(sh)
+                else:
+                    shadows.append(p)
+            group = ParameterGroup(shadows)
             group.compute(
                 self.mainframe, compute_id, names, shares[i], local_range,
                 global_offset=refs[i], values=values,
             )
+            for p, sh in zip(params, shadows):
+                if sh is p or not (p.flags.write and not p.flags.read_only):
+                    continue
+                if p.flags.write_all:
+                    np.copyto(p.host(), sh.host())
+                else:
+                    epw = p.flags.elements_per_work_item
+                    lo, hi = refs[i] * epw, (refs[i] + shares[i]) * epw
+                    p.host()[lo:hi] = sh.host()[lo:hi]
             return (time.perf_counter() - t0) * 1000.0
 
         futures = [self._pool.submit(run_client, i) for i in range(len(self.clients))]

@@ -83,9 +83,14 @@ class CruncherClient:
         global_range: int,
         local_range: int,
         values=(),
+        snapshot: dict | None = None,
     ) -> None:
         """Run this node's share [global_offset, global_offset+global_range)
-        remotely; blocks and writes results back into ``params``."""
+        remotely; blocks and writes results back into ``params``.
+
+        ``snapshot`` maps ``id(param) -> numpy copy``: when given, read
+        payloads marshal from the snapshot so concurrent writebacks from
+        other nodes can't tear the input view."""
         msg = Message(
             Command.COMPUTE,
             meta={
@@ -102,6 +107,8 @@ class CruncherClient:
             aid = id(p)
             msg.meta[f"size_{aid}"] = p.size
             host = p.host()
+            if snapshot is not None and aid in snapshot:
+                host = snapshot[aid]
             if flags & FLAG_READ:
                 if flags & FLAG_PARTIAL:
                     epw = p.flags.elements_per_work_item

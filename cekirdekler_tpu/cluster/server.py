@@ -201,8 +201,10 @@ class CruncherServer:
                 session.conn.close()
             except OSError:
                 pass
+        me = threading.current_thread()
         for session in self._sessions:
-            session.join(timeout=2.0)
+            if session is not me:  # SERVER_STOP arrives on a session thread
+                session.join(timeout=2.0)
         self._sessions.clear()
 
     def __enter__(self) -> "CruncherServer":

@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import threading
 import time
+from collections import deque
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 from typing import Any, Sequence
@@ -80,6 +81,9 @@ class Cores:
         self.histories: dict[int, BalanceHistory] = {}
         self._cont_ranges: dict[int, list[float]] = {}  # continuous balancer state
         self.perf: dict[int, ComputePerf] = {}
+        # rolling perf records per compute id (reference keeps only the
+        # last report, Cores.cs:994-1063; we keep a queryable history)
+        self.perf_log: dict[int, deque] = {}
         self.performance_feed = False
         self.smooth_load_balancer = True
         self.fixed_compute_powers: list[float] | None = None  # normalizedComputePowersOfDevices
@@ -172,10 +176,13 @@ class Cores:
                 f"global_range ({global_range}) must be divisible by step ({step})"
             )
         t_start = time.perf_counter()
-        # enqueue mode pins the ranges: data stays resident per the current
-        # partition, so moving shares between chips would compute on stale
-        # regions (the reference supports enqueue mode on the single-device
-        # path only, Cores.cs:836-949)
+        # enqueue mode pins the ranges: (a) read-resident data would go
+        # stale if shares moved between chips; (b) without per-call host
+        # sync the benchmarks only measure async dispatch time, so
+        # rebalancing on them is noise; (c) a chip whose share dropped to
+        # zero would leave a stale deferred-download record for flush().
+        # (The reference supports enqueue mode single-device only,
+        # Cores.cs:836-949.)
         ranges, refs = self._ranges_for(
             compute_id, global_range, step, rebalance=not self.enqueue_mode
         )
@@ -233,6 +240,7 @@ class Cores:
             total_ms=(time.perf_counter() - t_start) * 1000.0,
         )
         self.perf[compute_id] = perf
+        self.perf_log.setdefault(compute_id, deque(maxlen=64)).append(perf)
         self.last_compute_id = compute_id
         if self.performance_feed:
             print(perf.report(self.device_names()))
@@ -417,6 +425,27 @@ class Cores:
 
     def benchmarks_of(self, compute_id: int) -> list[float]:
         return [w.benchmarks.get(compute_id, 0.0) for w in self.workers]
+
+    def performance_history(self, compute_id: int) -> list[ComputePerf]:
+        return list(self.perf_log.get(compute_id, ()))
+
+    def barrier(self) -> None:
+        """Block until all dispatched device work has retired WITHOUT
+        reading results back (enqueue-mode sync point; the reference's
+        finish() on the used queues, Worker.cs:364-423).
+
+        Materializes one element per buffer: on tunneled backends (axon)
+        ``block_until_ready`` can return before remote execution finishes,
+        so a 4-byte D2H is the reliable fence."""
+        import numpy as _np
+
+        for w in self.workers:
+            for buf in w._buffers.values():
+                try:
+                    buf.block_until_ready()
+                    _np.asarray(buf[:1])
+                except Exception:
+                    pass
 
     def ranges_of(self, compute_id: int) -> list[int]:
         return list(self.global_ranges.get(compute_id, []))

@@ -129,11 +129,50 @@ class NumberCruncher:
         s = sum(powers)
         self.cores.fixed_compute_powers = [p / s for p in powers]
 
+    # -- fine-grained queue control (reference: ClNumberCruncher.cs:81-85,
+    # 356-372) ---------------------------------------------------------------
+    @property
+    def fine_grained_queue_control(self) -> bool:
+        return any(w.markers is not None for w in self.cores.workers)
+
+    @fine_grained_queue_control.setter
+    def fine_grained_queue_control(self, v: bool) -> None:
+        from ..utils.markers import MarkerCounter
+
+        for w in self.cores.workers:
+            if v and w.markers is None:
+                w.markers = MarkerCounter()
+            elif not v:
+                w.markers = None
+
+    def count_markers_remaining(self) -> int:
+        return sum(
+            w.markers.remaining() for w in self.cores.workers if w.markers is not None
+        )
+
+    def count_markers_reached(self) -> int:
+        return sum(
+            w.markers.reached for w in self.cores.workers if w.markers is not None
+        )
+
+    def marker_reach_speed(self) -> float:
+        speeds = [
+            w.markers.reach_speed() for w in self.cores.workers if w.markers is not None
+        ]
+        return sum(speeds)
+
+    def performance_history(self, compute_id: int):
+        return self.cores.performance_history(compute_id)
+
     # -- sync / reporting ----------------------------------------------------
     def flush(self) -> None:
         """Join deferred enqueue-mode work (reference:
         flushLastUsedCommandQueue, ClNumberCruncher.cs:100-106)."""
         self.cores.flush()
+
+    def barrier(self) -> None:
+        """Wait for all device work without host readback."""
+        self.cores.barrier()
 
     def performance_report(self, compute_id: int | None = None) -> str:
         return self.cores.performance_report(compute_id)

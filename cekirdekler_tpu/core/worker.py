@@ -32,6 +32,7 @@ from jax import lax
 
 from ..arrays.clarray import ClArray
 from ..kernel.registry import KernelProgram
+from ..utils.markers import MarkerCounter
 
 __all__ = ["Worker"]
 
@@ -72,6 +73,9 @@ class Worker:
         # per-compute-id accumulated wall ms (reference: Worker.cs:190,753-807)
         self.benchmarks: dict[int, float] = {}
         self._bench_t0: dict[int, float] = {}
+        # fine-grained progress markers (reference: queue markers,
+        # ClCommandQueue.cs:99-115); None unless enabled by the cruncher
+        self.markers: MarkerCounter | None = None
 
     # -- benchmarks ----------------------------------------------------------
     def start_bench(self, compute_id: int) -> None:
@@ -105,8 +109,12 @@ class Worker:
             self._buffer_owner[key] = arr
             return
         buf = self._buffer_for(arr)
+        if self.markers is not None:
+            self.markers.add()
         sl = jax.device_put(host[offset_elems : offset_elems + size_elems], self.device)
         self._buffers[key] = _update_slice(buf, sl, offset_elems)
+        if self.markers is not None:
+            self.markers.reach()
 
     def ensure_resident(self, arr: ClArray) -> Any:
         """Buffer for a non-read array: reuse cache or zeros (the kernel is
@@ -169,6 +177,9 @@ class Worker:
                     offset -= size  # rewind for next kernel/repeat
         for p, b in zip(params, bufs):
             self._buffers[id(p)] = b
+        if self.markers is not None:
+            self.markers.add(len(kernel_names))
+            self.markers.reach(len(kernel_names))
 
     # -- readback ------------------------------------------------------------
     def download_async(self, arr: ClArray, offset_elems: int, size_elems: int, full: bool):
@@ -181,18 +192,22 @@ class Worker:
         else:
             out = _slice_out(buf, offset_elems, size_elems)
             off = offset_elems
+        if self.markers is not None:
+            self.markers.add()
         try:
             out.copy_to_host_async()
         except Exception:
             pass
-        return (arr, out, off)
+        return (arr, out, off, self.markers)
 
     @staticmethod
     def finish_download(handle) -> None:
-        arr, out, off = handle
+        arr, out, off, markers = handle
         host = arr.host()
         data = np.asarray(out)
         host[off : off + data.size] = data
+        if markers is not None:
+            markers.reach()
 
     def dispose(self) -> None:
         self._buffers.clear()

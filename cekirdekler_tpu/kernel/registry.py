@@ -45,9 +45,13 @@ class PythonKernel:
     name: str
     array_params: list[str]
     value_params: list[str] = field(default_factory=list)
+    # treat the values tuple as a static jit argument (hashable python
+    # scalars): lets the kernel body use them as compile-time constants
+    # (e.g. loop bounds inside a Pallas kernel)
+    static_values: bool = False
 
 
-def kernel(fn: Callable | None = None, *, name: str | None = None):
+def kernel(fn: Callable | None = None, *, name: str | None = None, static_values: bool = False):
     """Decorator: register a Python/JAX function as a kernel.
 
     >>> @kernel
@@ -66,7 +70,10 @@ def kernel(fn: Callable | None = None, *, name: str | None = None):
             )
         arrays = [p.name for p in params[1:] if p.default is inspect.Parameter.empty]
         values = [p.name for p in params[1:] if p.default is not inspect.Parameter.empty]
-        return PythonKernel(fn=f, name=name or f.__name__, array_params=arrays, value_params=values)
+        return PythonKernel(
+            fn=f, name=name or f.__name__, array_params=arrays,
+            value_params=values, static_values=static_values,
+        )
 
     return deco(fn) if fn is not None else deco
 
@@ -166,7 +173,8 @@ class KernelProgram:
                 f"kernel {name!r} not found; available: {self.kernel_names}"
             )
 
-        jitted = jax.jit(raw_fn)
+        static = name in self._py_kernels and self._py_kernels[name].static_values
+        jitted = jax.jit(raw_fn, static_argnums=(2,) if static else ())
         with self._lock:
             self._cache[key] = (jitted, info)
         return jitted, info

@@ -1,0 +1,13 @@
+"""Pallas TPU kernels for the framework's hot ops.
+
+These are the hand-tiled paths the kernel language can't reach: they plug
+into the compute API as :class:`~cekirdekler_tpu.kernel.registry.
+PythonKernel` functions (the escape hatch for raw-Pallas kernels,
+kernel/registry.py) or are called directly.  Off-TPU they run under the
+Pallas interpreter so the CPU test rig covers them.
+"""
+
+from .elementwise import map_blocks, saxpy
+from .mandelbrot import mandelbrot_pallas
+
+__all__ = ["map_blocks", "mandelbrot_pallas", "saxpy"]

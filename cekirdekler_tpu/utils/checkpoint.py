@@ -1,0 +1,139 @@
+"""Checkpoint/resume for host arrays and (sharded) jax pytrees.
+
+The reference has NO checkpointing (SURVEY.md §5.4 — state lives in user
+arrays, 'checkpoint' is implicitly the user's own host copies); this is a
+new subsystem the TPU build adds.  Two surfaces:
+
+- :func:`save_arrays` / :func:`load_arrays` — ClArray/numpy dict → one
+  ``.npz`` (the compute-framework tier: user arrays are the state).
+- :func:`save_pytree` / :func:`load_pytree` — arbitrary pytrees of
+  jax/numpy arrays (model params + optimizer state), one ``.npy`` per
+  leaf plus a json manifest of the treedef; sharded ``jax.Array`` leaves
+  are fetched to host (process-local) before writing and can be re-placed
+  on load with a ``sharding_fn``.
+
+Writes are atomic: a temp directory renamed into place, so a killed run
+never leaves a half checkpoint (resume-safety the reference lacks).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import tempfile
+from typing import Any, Callable, Mapping
+
+import jax
+import numpy as np
+
+__all__ = [
+    "save_arrays",
+    "load_arrays",
+    "save_pytree",
+    "load_pytree",
+    "latest_step",
+]
+
+
+def _step_dir(root: str, step: int) -> str:
+    return os.path.join(root, f"step_{step:012d}")
+
+
+def latest_step(root: str) -> int | None:
+    """Highest checkpoint step under ``root`` (None if empty)."""
+    if not os.path.isdir(root):
+        return None
+    steps = [
+        int(name[5:]) for name in os.listdir(root)
+        if name.startswith("step_") and name[5:].isdigit()
+    ]
+    return max(steps) if steps else None
+
+
+def _atomic_write(root: str, step: int, write_fn: Callable[[str], None]) -> str:
+    os.makedirs(root, exist_ok=True)
+    final = _step_dir(root, step)
+    tmp = tempfile.mkdtemp(prefix=".ckpt_tmp_", dir=root)
+    try:
+        write_fn(tmp)
+        if os.path.isdir(final):
+            shutil.rmtree(final)
+        os.replace(tmp, final)
+    except Exception:
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
+    return final
+
+
+# -- array-dict surface ------------------------------------------------------
+
+def save_arrays(root: str, step: int, arrays: Mapping[str, Any]) -> str:
+    """Checkpoint named host arrays (ClArray or numpy) at ``step``."""
+    host = {}
+    for name, arr in arrays.items():
+        host[name] = np.asarray(arr.host() if hasattr(arr, "host") else arr)
+
+    def write(tmp: str) -> None:
+        np.savez(os.path.join(tmp, "arrays.npz"), **host)
+
+    return _atomic_write(root, step, write)
+
+
+def load_arrays(root: str, step: int | None = None) -> dict[str, np.ndarray]:
+    """Load the arrays of ``step`` (default: latest)."""
+    if step is None:
+        step = latest_step(root)
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints under {root}")
+    with np.load(os.path.join(_step_dir(root, step), "arrays.npz")) as z:
+        return {k: z[k].copy() for k in z.files}
+
+
+# -- pytree surface ----------------------------------------------------------
+
+def save_pytree(root: str, step: int, tree: Any) -> str:
+    """Checkpoint a pytree of jax/numpy arrays (params, optimizer state)."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+
+    def write(tmp: str) -> None:
+        manifest = {"treedef": str(treedef), "n_leaves": len(leaves), "step": step}
+        for i, leaf in enumerate(leaves):
+            np.save(os.path.join(tmp, f"leaf_{i:05d}.npy"), np.asarray(leaf))
+        with open(os.path.join(tmp, "manifest.json"), "w") as f:
+            json.dump(manifest, f)
+
+    return _atomic_write(root, step, write)
+
+
+def load_pytree(
+    root: str,
+    like: Any,
+    step: int | None = None,
+    sharding_fn: Callable[[Any, np.ndarray], Any] | None = None,
+) -> Any:
+    """Restore a pytree saved by :func:`save_pytree`.
+
+    ``like`` supplies the tree structure (e.g. a freshly-initialized params
+    pytree).  ``sharding_fn(like_leaf, loaded)`` may re-place each leaf
+    (e.g. ``lambda l, x: jax.device_put(x, l.sharding)``).
+    """
+    if step is None:
+        step = latest_step(root)
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints under {root}")
+    d = _step_dir(root, step)
+    like_leaves, treedef = jax.tree_util.tree_flatten(like)
+    with open(os.path.join(d, "manifest.json")) as f:
+        manifest = json.load(f)
+    if manifest["n_leaves"] != len(like_leaves):
+        raise ValueError(
+            f"checkpoint has {manifest['n_leaves']} leaves, 'like' tree has {len(like_leaves)}"
+        )
+    loaded = []
+    for i, like_leaf in enumerate(like_leaves):
+        x = np.load(os.path.join(d, f"leaf_{i:05d}.npy"))
+        if sharding_fn is not None:
+            x = sharding_fn(like_leaf, x)
+        loaded.append(x)
+    return jax.tree_util.tree_unflatten(treedef, loaded)

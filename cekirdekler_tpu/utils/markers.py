@@ -1,0 +1,75 @@
+"""Progress markers: the framework's dispatch-progress observability
+primitive.
+
+The reference's one real observability feature is queue markers — native
+callbacks count how many enqueued markers a command queue has reached,
+giving in-flight depth and a smoothed 'marker reach speed' used by the
+pool scheduler for throttling (ClCommandQueue.cs:99-115,
+ClNumberCruncher.cs:356-372, ClPipeline.cs:4788-4827).  The TPU analogue
+counts dispatched vs retired operations per lane: XLA dispatch is async,
+so 'reached' means the op's result became ready (host callback /
+``block_until_ready`` completion).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+
+__all__ = ["MarkerCounter"]
+
+
+class MarkerCounter:
+    """Dispatched/retired op counting + smoothed retire rate.
+
+    ``add()`` marks a dispatch; ``reach()`` marks completion.  The rate
+    estimate averages the last ``window`` retire intervals (the
+    reference's 15-sample markerReachSpeed smoothing,
+    ClPipeline.cs:4788-4817).
+    """
+
+    def __init__(self, window: int = 15):
+        self._lock = threading.Lock()
+        self._added = 0
+        self._reached = 0
+        self._times: deque[float] = deque(maxlen=window)
+
+    def add(self, n: int = 1) -> None:
+        with self._lock:
+            self._added += n
+
+    def reach(self, n: int = 1) -> None:
+        now = time.perf_counter()
+        with self._lock:
+            self._reached += n
+            self._times.append(now)
+
+    @property
+    def added(self) -> int:
+        with self._lock:
+            return self._added
+
+    @property
+    def reached(self) -> int:
+        with self._lock:
+            return self._reached
+
+    def remaining(self) -> int:
+        """In-flight depth (reference: countMarkersRemaining)."""
+        with self._lock:
+            return self._added - self._reached
+
+    def reach_speed(self) -> float:
+        """Retired ops/second over the smoothing window (0 if <2 samples)."""
+        with self._lock:
+            if len(self._times) < 2:
+                return 0.0
+            span = self._times[-1] - self._times[0]
+            return (len(self._times) - 1) / span if span > 0 else 0.0
+
+    def reset(self) -> None:
+        with self._lock:
+            self._added = 0
+            self._reached = 0
+            self._times.clear()

@@ -100,6 +100,31 @@ __kernel void streamAdd(__global float* a, __global float* b, __global float* c)
 """
 
 
+def mandelbrot_pallas_kernel(interpret: bool | None = None):
+    """The mandelbrot workload as a raw-Pallas :class:`PythonKernel` —
+    the hand-tiled hot path (ops/mandelbrot.py) plugged into the same
+    compute()/balancer machinery as the C-subset kernel.
+
+    ``interpret`` must be True when the kernel will run on CPU devices
+    (the default-backend autodetect can't see which chips the scheduler
+    dispatches to)."""
+    import jax.lax
+
+    from .kernel.registry import kernel
+    from .ops.mandelbrot import mandelbrot_pallas
+
+    @kernel(name="mandelbrot", static_values=True)
+    def mandelbrot(gid, out, x0=0.0, y0=0.0, dx=0.0, dy=0.0, width=0, maxIter=0):
+        chunk = gid.shape[0]
+        piece = mandelbrot_pallas(
+            chunk, x0, y0, dx, dy, width, maxIter, offset=gid[0],
+            interpret=interpret,
+        )
+        return jax.lax.dynamic_update_slice(out, piece, (gid[0],))
+
+    return mandelbrot
+
+
 def mandelbrot_host(
     width: int, height: int, x0: float, y0: float, dx: float, dy: float, max_iter: int
 ) -> np.ndarray:
@@ -162,22 +187,41 @@ def run_mandelbrot(
     local_range: int = 256,
     keep_image: bool = False,
     cruncher: NumberCruncher | None = None,
+    use_pallas: bool = False,
+    readback: str = "every",
+    sync_every: int = 1,
 ) -> MandelbrotResult:
     """Timed, load-balanced mandelbrot over all selected chips.
 
+    ``use_pallas`` swaps the kernel-language program for the hand-tiled
+    Pallas kernel (same name, same compute path).  ``readback="final"``
+    runs in enqueue mode — the image stays in HBM, iterations sync to a
+    device barrier every ``sync_every`` steps (amortizing per-sync latency
+    on tunneled backends), and one flush at the end writes the host array
+    (the device-throughput view; "every" includes a full D2H per
+    iteration).
     Returns Mpixels/sec over the timed iterations plus per-iteration wall
-    times and the balancer's range trajectory (for the convergence metric in
-    BASELINE.md).
+    times and the balancer's range trajectory (for the convergence metric
+    in BASELINE.md).
     """
     from .hardware import all_devices
 
     own = cruncher is None
-    cr = cruncher or NumberCruncher(devices or all_devices(), MANDELBROT_SRC)
+    devs = devices or all_devices()
+    if use_pallas:
+        source = mandelbrot_pallas_kernel(
+            interpret=not all(d.is_tpu for d in devs)
+        )
+    else:
+        source = MANDELBROT_SRC
+    cr = cruncher or NumberCruncher(devs, source)
     n = width * height
     out = ClArray(n, np.float32, name="mandel_out", read=False, write=True)
     vals = (-2.0, -1.25, 2.5 / width, 2.5 / height, width, max_iter)
     per_iter: list[float] = []
     ranges: list[list[int]] = []
+    if readback == "final":
+        cr.enqueue_mode = True
     try:
         for k in range(warmup + iters):
             t0 = time.perf_counter()
@@ -185,12 +229,21 @@ def run_mandelbrot(
                 cr, 7001, "mandelbrot", n, local_range,
                 pipeline=pipeline, pipeline_blobs=pipeline_blobs, values=vals,
             )
+            last = k == warmup + iters - 1
+            if readback == "final" and ((k + 1) % sync_every == 0 or last):
+                cr.barrier()
             dt_ms = (time.perf_counter() - t0) * 1000.0
             ranges.append(cr.ranges_of(7001))
             if k >= warmup:
                 per_iter.append(dt_ms)
+            elif k == warmup - 1 and readback == "final":
+                # fence: warmup dispatches must retire OUTSIDE the timed
+                # window or their device time deflates the metric
+                cr.barrier()
         mpix = (n * len(per_iter)) / (sum(per_iter) / 1000.0) / 1e6
         step = local_range * (pipeline_blobs if pipeline else 1)
+        if readback == "final":
+            cr.enqueue_mode = False  # flush: one readback for the image
         return MandelbrotResult(
             mpixels_per_sec=mpix,
             per_iter_ms=per_iter,
@@ -199,6 +252,13 @@ def run_mandelbrot(
             image=out.host().reshape(height, width).copy() if keep_image else None,
         )
     finally:
+        # never leave a caller-supplied cruncher stuck in enqueue mode
+        # (deferred readbacks would silently stop updating host arrays)
+        if cr.enqueue_mode:
+            try:
+                cr.enqueue_mode = False
+            except Exception:
+                pass
         if own:
             cr.dispose()
 

@@ -1,0 +1,54 @@
+"""Pallas ops tests (interpreter mode on the CPU rig) — parity with host
+references and integration with the compute path."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import cekirdekler_tpu as ct
+from cekirdekler_tpu.ops import map_blocks, mandelbrot_pallas, saxpy
+from cekirdekler_tpu.workloads import mandelbrot_host, run_mandelbrot
+
+
+def test_mandelbrot_pallas_matches_host():
+    w, h, it = 256, 64, 48
+    got = mandelbrot_pallas(
+        w * h, -2.0, -1.25, 2.5 / w, 2.5 / h, w, it, interpret=True
+    )
+    want = mandelbrot_host(w, h, -2.0, -1.25, 2.5 / w, 2.5 / h, it)
+    frac = float(np.mean(np.asarray(got) == want))
+    assert frac > 0.999, f"only {frac:.4f} pixels agree"
+
+
+def test_mandelbrot_pallas_offset_chunk():
+    """A chunk [offset, offset+n) must equal that slice of the full image."""
+    w, h, it = 128, 64, 32
+    full = mandelbrot_pallas(w * h, -2.0, -1.25, 2.5 / w, 2.5 / h, w, it, interpret=True)
+    chunk = mandelbrot_pallas(
+        1024, -2.0, -1.25, 2.5 / w, 2.5 / h, w, it,
+        offset=jnp.int32(2048), interpret=True,
+    )
+    np.testing.assert_array_equal(np.asarray(chunk), np.asarray(full)[2048:3072])
+
+
+def test_saxpy_and_map_blocks():
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal(1024), jnp.float32)
+    y = jnp.asarray(rng.standard_normal(1024), jnp.float32)
+    got = saxpy(2.5, x, y, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(y + 2.5 * x), rtol=1e-6)
+    got2 = map_blocks(lambda a, b: jnp.maximum(a, b), x, y, interpret=True)
+    np.testing.assert_array_equal(np.asarray(got2), np.maximum(np.asarray(x), np.asarray(y)))
+
+
+def test_run_mandelbrot_pallas_path_multichip():
+    """The Pallas kernel rides the same compute()/balancer machinery."""
+    devs = ct.all_devices().cpus().subset(4)
+    res = run_mandelbrot(
+        devs, width=256, height=128, max_iter=32,
+        iters=3, warmup=0, keep_image=True, local_range=128, use_pallas=True,
+    )
+    want = mandelbrot_host(256, 128, -2.0, -1.25, 2.5 / 256, 2.5 / 128, 32)
+    frac = float(np.mean(res.image.ravel() == want))
+    assert frac > 0.999
+    assert sum(res.ranges_per_iter[-1]) == 256 * 128

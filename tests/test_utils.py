@@ -1,0 +1,128 @@
+"""Aux subsystem tests: checkpoint/resume (atomic, sharded pytrees),
+marker counters, perf history."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import cekirdekler_tpu as ct
+from cekirdekler_tpu.arrays.clarray import ClArray
+from cekirdekler_tpu.core.cruncher import NumberCruncher
+from cekirdekler_tpu.utils.checkpoint import (
+    latest_step,
+    load_arrays,
+    load_pytree,
+    save_arrays,
+    save_pytree,
+)
+from cekirdekler_tpu.utils.markers import MarkerCounter
+
+
+def _cpus(n=2):
+    return ct.all_devices().cpus().subset(n)
+
+
+# -- checkpoint --------------------------------------------------------------
+
+def test_array_checkpoint_roundtrip(tmp_path):
+    root = str(tmp_path / "ck")
+    a = ClArray(np.arange(100, dtype=np.float32))
+    save_arrays(root, 5, {"a": a, "b": np.ones(3)})
+    save_arrays(root, 9, {"a": a, "b": np.zeros(3)})
+    assert latest_step(root) == 9
+    got = load_arrays(root)  # latest
+    np.testing.assert_array_equal(got["a"], a.host())
+    np.testing.assert_array_equal(got["b"], np.zeros(3))
+    got5 = load_arrays(root, 5)
+    np.testing.assert_array_equal(got5["b"], np.ones(3))
+
+
+def test_pytree_checkpoint_roundtrip_with_sharding(tmp_path):
+    from cekirdekler_tpu import parallel as par
+    from cekirdekler_tpu.models import Transformer, TransformerConfig
+
+    root = str(tmp_path / "ck")
+    cfg = TransformerConfig(vocab=32, d_model=16, n_layers=1, n_heads=2,
+                            d_ff=32, dtype=jnp.float32)
+    model = Transformer(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    mesh = par.make_mesh(jax.devices("cpu")[:4], dp=2, tp=2)
+    sharded = model.shard_params(params, mesh)
+    save_pytree(root, 100, sharded)
+
+    fresh = model.shard_params(model.init(jax.random.PRNGKey(1)), mesh)
+    restored = load_pytree(
+        root, fresh, sharding_fn=lambda l, x: jax.device_put(x, l.sharding)
+    )
+    for a, b in zip(jax.tree_util.tree_leaves(sharded),
+                    jax.tree_util.tree_leaves(restored)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        assert b.sharding == a.sharding
+
+
+def test_checkpoint_atomicity_no_partial_dirs(tmp_path):
+    root = str(tmp_path / "ck")
+    save_arrays(root, 1, {"x": np.ones(4)})
+    leftovers = [d for d in os.listdir(root) if d.startswith(".ckpt_tmp_")]
+    assert leftovers == []
+
+
+def test_load_missing_checkpoint_raises(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        load_arrays(str(tmp_path / "none"))
+
+
+# -- markers -----------------------------------------------------------------
+
+def test_marker_counter_basics():
+    m = MarkerCounter(window=4)
+    m.add(3)
+    assert m.remaining() == 3
+    m.reach()
+    m.reach()
+    assert m.reached == 2 and m.remaining() == 1
+    m.reach()
+    assert m.reach_speed() >= 0.0
+    m.reset()
+    assert m.added == 0 and m.remaining() == 0
+
+
+def test_fine_grained_queue_control_counts_ops():
+    n = 256
+    a = ClArray(np.zeros(n, np.float32))
+    cr = NumberCruncher(
+        _cpus(2),
+        "__kernel void f(__global float* a){ int i=get_global_id(0); a[i]+=1.0f; }",
+    )
+    try:
+        cr.fine_grained_queue_control = True
+        a.compute(cr, 1, "f", n, 64)
+        assert cr.count_markers_reached() > 0
+        assert cr.count_markers_remaining() == 0  # compute() is synchronous
+        cr.fine_grained_queue_control = False
+        assert not cr.fine_grained_queue_control
+    finally:
+        cr.dispose()
+
+
+# -- perf history ------------------------------------------------------------
+
+def test_performance_history_accumulates():
+    n = 256
+    a = ClArray(np.zeros(n, np.float32))
+    cr = NumberCruncher(
+        _cpus(2),
+        "__kernel void f(__global float* a){ int i=get_global_id(0); a[i]+=1.0f; }",
+    )
+    try:
+        for _ in range(4):
+            a.compute(cr, 7, "f", n, 64)
+        hist = cr.performance_history(7)
+        assert len(hist) == 4
+        assert all(p.compute_id == 7 for p in hist)
+        assert sum(hist[-1].device_items) == n
+    finally:
+        cr.dispose()
