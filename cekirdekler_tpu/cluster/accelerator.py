@@ -167,14 +167,12 @@ class ClusterAccelerator(IComputeNode):
             )
             return (time.perf_counter() - t0) * 1000.0
 
-        def run_mainframe():
-            i = len(self.clients)
-            if shares[i] <= 0:
-                return 0.0
+        def mainframe_share(goff: int, grange: int) -> float:
+            """Run [goff, goff+grange) on the mainframe against shadow
+            arrays (its own copies of the snapshot), then copy the written
+            ranges back — reading live host arrays would race client
+            writebacks."""
             t0 = time.perf_counter()
-            # the mainframe computes on shadows of read arrays (its own
-            # copies of the snapshot), then copies its written ranges back —
-            # reading live host arrays would race client writebacks
             shadows: list[ClArray] = []
             for p in params:
                 if eff_read(p):
@@ -192,8 +190,8 @@ class ClusterAccelerator(IComputeNode):
                     shadows.append(p)
             group = ParameterGroup(shadows)
             group.compute(
-                self.mainframe, compute_id, names, shares[i], local_range,
-                global_offset=refs[i], values=values,
+                self.mainframe, compute_id, names, grange, local_range,
+                global_offset=goff, values=values,
             )
             for p, sh in zip(params, shadows):
                 if sh is p or not (p.flags.write and not p.flags.read_only):
@@ -202,13 +200,47 @@ class ClusterAccelerator(IComputeNode):
                     np.copyto(p.host(), sh.host())
                 else:
                     epw = p.flags.elements_per_work_item
-                    lo, hi = refs[i] * epw, (refs[i] + shares[i]) * epw
+                    lo, hi = goff * epw, (goff + grange) * epw
                     p.host()[lo:hi] = sh.host()[lo:hi]
             return (time.perf_counter() - t0) * 1000.0
 
+        def run_mainframe():
+            i = len(self.clients)
+            if shares[i] <= 0:
+                return 0.0
+            return mainframe_share(refs[i], shares[i])
+
         futures = [self._pool.submit(run_client, i) for i in range(len(self.clients))]
         futures.append(self._pool.submit(run_mainframe))
-        self.timings[compute_id] = [f.result() for f in futures]
+        timings: list[float] = []
+        failed: list[int] = []
+        for i, f in enumerate(futures):
+            try:
+                timings.append(f.result())
+            except Exception:
+                # node loss mid-compute (reference leaves this unhandled,
+                # SURVEY.md §5.3): remember the node, recover its share
+                timings.append(0.0)
+                failed.append(i)
+        if failed:
+            # failover: the mainframe recomputes every lost share (serially,
+            # AFTER all surviving writebacks — shares are disjoint)
+            for i in failed:
+                if shares[i] > 0:
+                    timings[-1] += mainframe_share(refs[i], shares[i])
+            # drop dead nodes and reset balancer state (step lists changed)
+            dead = {id(self.clients[i]) for i in failed}
+            for i in failed:
+                try:
+                    self.clients[i].close()
+                except Exception:
+                    pass
+            self.clients = [c for c in self.clients if id(c) not in dead]
+            self.balancers.clear()
+            self.ranges.clear()
+            self.timings.clear()
+            return
+        self.timings[compute_id] = timings
 
     def compute_timing(self, compute_id: int) -> list[float]:
         return list(self.timings.get(compute_id, []))

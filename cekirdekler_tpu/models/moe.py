@@ -1,0 +1,61 @@
+"""Mixture-of-experts FFN with expert parallelism (ep axis).
+
+Experts' weights shard over ``ep`` — each chip holds E/ep experts' params
+(the memory win expert parallelism exists for) and computes its experts'
+outputs for every token; a top-1 router gates, and a ``psum`` over ep
+combines.  Tokens are replicated across ep (they remain sharded over the
+data/sequence axes, which stay in GSPMD auto mode: ``axis_names={'ep'}``).
+
+This is the dense ("compute-all, mask") formulation: simple, exactly
+differentiable, and correct for any router outcome; the all-to-all
+capacity-dispatch variant is the flop-optimal successor and slots in
+behind the same function signature.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+__all__ = ["moe_ffn", "moe_ffn_sharded"]
+
+
+def moe_ffn(x, router, w1, w2, axis: str | None = None):
+    """Top-1 routed expert FFN.
+
+    x [B,T,d]; router [d,E]; w1 (local) [E_local,d,f]; w2 [E_local,f,d].
+    With ``axis`` bound (inside shard_map) E_local = E/ep and results
+    psum-combine; with ``axis=None`` w1/w2 hold all experts.
+    """
+    dt = x.dtype
+    logits = (x.astype(jnp.float32) @ router.astype(jnp.float32))  # [B,T,E]
+    gate_all = jax.nn.softmax(logits, axis=-1)
+    idx = jnp.argmax(logits, axis=-1)                              # [B,T]
+    g = jnp.take_along_axis(gate_all, idx[..., None], axis=-1)[..., 0]
+
+    e0 = lax.axis_index(axis) * w1.shape[0] if axis is not None else 0
+    h = jnp.einsum("btd,edf->ebtf", x, w1.astype(dt))
+    h = jax.nn.gelu(h)
+    o = jnp.einsum("ebtf,efd->ebtd", h, w2.astype(dt))
+    local_e = jnp.arange(w1.shape[0]) + e0
+    sel = (idx[None, :, :] == local_e[:, None, None]).astype(jnp.float32)
+    y = jnp.sum(o.astype(jnp.float32) * (sel * g[None])[..., None], axis=0)
+    if axis is not None:
+        y = lax.psum(y, axis)
+    return y.astype(dt)
+
+
+def moe_ffn_sharded(mesh: Mesh, x, router, w1, w2, axis: str = "ep"):
+    """shard_map wrapper: w1/w2 are global [E,d,f]/[E,f,d] sharded on dim 0
+    over ``axis``; x and router replicated over it (their other shardings
+    stay auto)."""
+    fn = jax.shard_map(
+        lambda xx, r, a, b: moe_ffn(xx, r, a, b, axis=axis),
+        mesh=mesh,
+        in_specs=(P(), P(), P(axis), P(axis)),
+        out_specs=P(),
+        axis_names={axis},
+    )
+    return fn(x, router, w1, w2)

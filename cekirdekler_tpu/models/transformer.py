@@ -47,10 +47,25 @@ class TransformerConfig:
     attention: str = "dense"            # "dense" | "ring" | "ulysses"
     remat: bool = False
     sp_axis: str = "sp"
+    # mixture of experts: n_experts > 0 turns every ``moe_every``-th block's
+    # FFN into a top-1 routed expert layer (experts shard over ep)
+    n_experts: int = 0
+    moe_every: int = 2
+    # pipeline parallelism: pp_stages > 1 stacks the blocks and runs them
+    # GPipe-style over the pp axis with n_microbatches per step
+    pp_stages: int = 1
+    n_microbatches: int = 1
 
     @property
     def head_dim(self) -> int:
         return self.d_model // self.n_heads
+
+    def is_moe_block(self, i: int) -> bool:
+        if self.n_experts <= 0:
+            return False
+        if self.pp_stages > 1:
+            return True  # pp needs homogeneous (stackable) blocks
+        return (i + 1) % self.moe_every == 0
 
 
 def _init(rng, shape, scale, dtype):
@@ -73,36 +88,64 @@ class Transformer:
             "blocks": [],
         }
         for i in range(c.n_layers):
-            ks = jax.random.split(keys[2 + i], 4)
+            ks = jax.random.split(keys[2 + i], 5)
             d, h, f = c.d_model, c.n_heads * c.head_dim, c.d_ff
-            params["blocks"].append(
-                {
-                    "ln1": jnp.ones((d,), c.param_dtype),
-                    "wqkv": _init(ks[0], (d, 3 * h), d**-0.5, c.param_dtype),
-                    "wo": _init(ks[1], (h, d), h**-0.5, c.param_dtype),
-                    "ln2": jnp.ones((d,), c.param_dtype),
-                    "w1": _init(ks[2], (d, f), d**-0.5, c.param_dtype),
-                    "w2": _init(ks[3], (f, d), f**-0.5, c.param_dtype),
-                }
-            )
+            block = {
+                "ln1": jnp.ones((d,), c.param_dtype),
+                "wqkv": _init(ks[0], (d, 3 * h), d**-0.5, c.param_dtype),
+                "wo": _init(ks[1], (h, d), h**-0.5, c.param_dtype),
+                "ln2": jnp.ones((d,), c.param_dtype),
+            }
+            if c.is_moe_block(i):
+                block["router"] = _init(ks[4], (d, c.n_experts), 0.02, c.param_dtype)
+                block["w1"] = _init(ks[2], (c.n_experts, d, f), d**-0.5, c.param_dtype)
+                block["w2"] = _init(ks[3], (c.n_experts, f, d), f**-0.5, c.param_dtype)
+            else:
+                block["w1"] = _init(ks[2], (d, f), d**-0.5, c.param_dtype)
+                block["w2"] = _init(ks[3], (f, d), f**-0.5, c.param_dtype)
+            params["blocks"].append(block)
+        if c.pp_stages > 1:
+            from ..parallel.pipeline_parallel import stack_layers
+
+            if c.n_layers % c.pp_stages != 0:
+                raise ValueError(
+                    f"n_layers ({c.n_layers}) must divide into pp_stages ({c.pp_stages})"
+                )
+            params["blocks"] = stack_layers(params["blocks"])
         return params
 
     def param_specs(self) -> dict:
         """PartitionSpec pytree matching :meth:`init` — tp shards the head
         and ff dimensions, fsdp shards the other matmul dimension."""
         c = self.config
-        block = {
-            "ln1": P(),
-            "wqkv": P("fsdp", "tp"),
-            "wo": P("tp", "fsdp"),
-            "ln2": P(),
-            "w1": P("fsdp", "tp"),
-            "w2": P("tp", "fsdp"),
-        }
+
+        def block_spec(i: int) -> dict:
+            spec = {
+                "ln1": P(),
+                "wqkv": P("fsdp", "tp"),
+                "wo": P("tp", "fsdp"),
+                "ln2": P(),
+            }
+            if c.is_moe_block(i):
+                spec["router"] = P()
+                spec["w1"] = P("ep", "fsdp", "tp")
+                spec["w2"] = P("ep", "tp", "fsdp")
+            else:
+                spec["w1"] = P("fsdp", "tp")
+                spec["w2"] = P("tp", "fsdp")
+            return spec
+
+        blocks = [block_spec(i) for i in range(c.n_layers)]
+        if c.pp_stages > 1:
+            # stacked layer dim shards over pp (each stage holds its layers)
+            blocks = jax.tree_util.tree_map(
+                lambda s: P("pp", *s), blocks[0],
+                is_leaf=lambda x: isinstance(x, P),
+            )
         return {
             "embed": P("tp", "fsdp"),
             "final_norm": P(),
-            "blocks": [dict(block) for _ in range(c.n_layers)],
+            "blocks": blocks,
         }
 
     def shard_params(self, params: dict, mesh: Mesh) -> dict:
@@ -131,7 +174,7 @@ class Transformer:
         return attention_reference(q, k, v, causal=True)
 
     def _block(self, params: dict, x, mesh: Mesh | None):
-        """Pre-norm block: x + Attn(LN(x)); x + MLP(LN(x))."""
+        """Pre-norm block: x + Attn(LN(x)); x + FFN(LN(x)) (dense or MoE)."""
         c = self.config
         B, T, _ = x.shape
         h = _rms_norm(x, params["ln1"])
@@ -146,6 +189,16 @@ class Transformer:
             o = constrain(o, mesh, ("dp", "fsdp"), c.sp_axis, None)
         x = x + o
         h = _rms_norm(x, params["ln2"])
+        if "router" in params:
+            from .moe import moe_ffn, moe_ffn_sharded
+
+            if mesh is not None:
+                h = moe_ffn_sharded(mesh, h, params["router"], params["w1"], params["w2"])
+            else:
+                # under pp (or single device) GSPMD auto-shards the expert
+                # dim from the param shardings
+                h = moe_ffn(h, params["router"], params["w1"], params["w2"])
+            return x + h
         h = jax.nn.gelu(h @ params["w1"].astype(c.dtype))
         if mesh is not None:
             h = constrain(h, mesh, ("dp", "fsdp"), c.sp_axis, "tp")
@@ -170,6 +223,37 @@ class Transformer:
         if mesh is not None:
             logits = constrain(logits, mesh, ("dp", "fsdp"), c.sp_axis, "tp")
         return logits
+
+    def _apply_pipelined(self, stacked_blocks, x, mesh: Mesh | None):
+        """GPipe over the pp axis: each stage holds n_layers/pp stacked
+        layers; activations rotate around the ring per microbatch step
+        (parallel/pipeline_parallel.py).  Inside the stage the other mesh
+        axes stay in GSPMD auto mode, so blocks run with mesh=None."""
+        from ..parallel.pipeline_parallel import gpipe
+
+        c = self.config
+
+        def stage_fn(local_blocks, x_mb):
+            n_local = jax.tree_util.tree_leaves(local_blocks)[0].shape[0]
+
+            def one(bp_i, x_mb):
+                return self._block(bp_i, x_mb, None)
+
+            if c.remat:
+                one = jax.checkpoint(one)
+            for i in range(n_local):
+                bp_i = jax.tree_util.tree_map(lambda a: a[i], local_blocks)
+                x_mb = one(bp_i, x_mb)
+            return x_mb
+
+        if mesh is None:
+            # no mesh: run the stack sequentially (pp degenerates)
+            n = jax.tree_util.tree_leaves(stacked_blocks)[0].shape[0]
+            for i in range(n):
+                bp_i = jax.tree_util.tree_map(lambda a: a[i], stacked_blocks)
+                x = self._block(bp_i, x, None)
+            return x
+        return gpipe(stage_fn, stacked_blocks, x, c.n_microbatches, mesh)
 
     # -- training ------------------------------------------------------------
     def loss_fn(self, params: dict, batch: dict, mesh: Mesh | None = None):

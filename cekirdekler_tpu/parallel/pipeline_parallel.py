@@ -1,0 +1,84 @@
+"""Pipeline parallelism (pp axis): GPipe-style microbatched stage pipeline
+over collective-permute.
+
+The reference's device→device pipeline moves one generation per push
+through host hops (ClPipeline.cs:41-139); for model layers the TPU-native
+form keeps a stack of layers per chip and rotates ACTIVATIONS around the
+``pp`` ring each microbatch step: stage r computes microbatch m at step
+m + r, so all stages run concurrently once the pipe fills (wall time
+M + S - 1 steps — the GPipe bubble).
+
+Only ``pp`` is manualized (``axis_names={'pp'}``): dp/fsdp/tp/sp shardings
+of the activations and the per-stage parameters stay in GSPMD auto mode
+inside the stage function.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from .collectives import ppermute_ring
+
+__all__ = ["gpipe", "stack_layers"]
+
+
+def stack_layers(layer_params: list) -> Any:
+    """Stack per-layer pytrees into one pytree with a leading layer dim —
+    shard that dim over ``pp`` (each stage holds its contiguous layers)."""
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *layer_params)
+
+
+def gpipe(
+    stage_fn: Callable[[Any, Any], Any],
+    stacked_params: Any,
+    x,
+    n_microbatches: int,
+    mesh: Mesh,
+    axis: str = "pp",
+):
+    """Run ``x`` through the full layer stack pipelined over ``axis``.
+
+    ``stage_fn(local_params, x_mb)`` applies ONE stage's layers (its leaves
+    have the local [L/S, ...] leading dim).  ``x`` is replicated over the
+    pp axis (sharded however else); output is replicated over pp.
+    The batch dim must divide ``n_microbatches``.
+    """
+
+    def inner(params_local, xx):
+        S = lax.axis_size(axis)
+        r = lax.axis_index(axis)
+        B = xx.shape[0]
+        M = n_microbatches
+        if B % M != 0:
+            raise ValueError(f"batch {B} not divisible by {M} microbatches")
+        mb = B // M
+        xm = xx.reshape(M, mb, *xx.shape[1:])
+        buf = jnp.zeros_like(xm[0])
+        outs = []
+        for t in range(M + S - 1):
+            x_in = xm[min(t, M - 1)]
+            inp = jnp.where(r == 0, x_in, buf)
+            out = stage_fn(params_local, inp)
+            outs.append(out)
+            # stage r's output becomes stage r+1's next input
+            buf = ppermute_ring(out, axis, 1)
+        # microbatch m leaves the LAST stage at step m + S - 1
+        ys = jnp.concatenate([outs[m + S - 1] for m in range(M)], axis=0)
+        # only the last stage holds real results; broadcast around the ring
+        # (where, not multiply: bubble garbage may be nonfinite)
+        ys = jnp.where(r == S - 1, ys, jnp.zeros_like(ys))
+        return lax.psum(ys, axis)
+
+    fn = jax.shard_map(
+        inner,
+        mesh=mesh,
+        in_specs=(P(axis), P()),
+        out_specs=P(),
+        axis_names={axis},
+    )
+    return fn(stacked_params, x)

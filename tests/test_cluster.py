@@ -146,6 +146,31 @@ def test_cluster_balancer_starved_node_recovers():
     assert ranges[1] > 512  # starved node earned its work back
 
 
+def test_node_failure_mid_run_fails_over_to_mainframe(two_servers):
+    """Killing a server between computes must not lose results: the
+    mainframe recomputes the dead node's share and the node is dropped."""
+    s1, s2 = two_servers
+    n = 4096
+    x = ClArray(np.arange(n, dtype=np.float32), partial_read=True, read_only=True)
+    y = ClArray(np.zeros(n, np.float32), partial_read=True)
+    cluster = ClusterAccelerator(
+        [(s1.host, s1.port), (s2.host, s2.port)], local_devices=_cpus(2)
+    )
+    try:
+        cluster.setup_nodes(SRC)
+        cluster.compute("saxpy", [x, y], 910, n, 64, values=(1.0,))
+        np.testing.assert_allclose(y.host(), x.host(), rtol=1e-6)
+        s2.stop()  # node dies between iterations
+        cluster.compute("saxpy", [x, y], 910, n, 64, values=(1.0,))
+        np.testing.assert_allclose(y.host(), 2.0 * x.host(), rtol=1e-6)
+        assert len(cluster.clients) == 1  # dead node dropped
+        # next compute re-splits across survivors and stays correct
+        cluster.compute("saxpy", [x, y], 910, n, 64, values=(1.0,))
+        np.testing.assert_allclose(y.host(), 3.0 * x.host(), rtol=1e-6)
+    finally:
+        cluster.dispose()
+
+
 def test_probe_finds_live_servers(two_servers):
     s1, s2 = two_servers
     live = ClusterAccelerator.probe(
