@@ -125,20 +125,33 @@ class _ComputeMixin:
             pipeline_type = PIPELINE_EVENT
         params = self.parameters()
         names = kernels.split() if isinstance(kernels, str) else list(kernels)
+        # error gate: a cruncher that has already failed refuses further
+        # work until reset (reference: numberOfErrorsHappened checks,
+        # ClArray.cs:1610-1623, ClNumberCruncher.cs:374-392)
+        errs = getattr(cruncher, "number_of_errors_happened", 0)
+        if errs:
+            raise ComputeValidationError(
+                f"cruncher has {errs} previous error(s); call "
+                "reset_errors() before computing again"
+            )
         _validate_compute(params, names, global_range, local_range, pipeline, pipeline_blobs)
-        return cruncher.cores.compute(
-            kernel_names=names,
-            params=params,
-            compute_id=compute_id,
-            global_range=global_range,
-            local_range=local_range,
-            global_offset=global_offset,
-            pipeline=pipeline,
-            pipeline_blobs=pipeline_blobs,
-            pipeline_type=pipeline_type,
-            cruncher=cruncher,
-            value_args=values,
-        )
+        try:
+            return cruncher.cores.compute(
+                kernel_names=names,
+                params=params,
+                compute_id=compute_id,
+                global_range=global_range,
+                local_range=local_range,
+                global_offset=global_offset,
+                pipeline=pipeline,
+                pipeline_blobs=pipeline_blobs,
+                pipeline_type=pipeline_type,
+                cruncher=cruncher,
+                value_args=values,
+            )
+        except Exception:
+            cruncher.number_of_errors_happened = errs + 1
+            raise
 
     def task(
         self,

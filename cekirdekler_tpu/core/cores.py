@@ -220,6 +220,7 @@ class Cores:
                     global_range,
                     pipeline,
                     pipeline_blobs,
+                    pipeline_type,
                     value_args,
                     write_all_owner,
                 )
@@ -258,6 +259,7 @@ class Cores:
         global_range: int,
         pipeline: bool,
         blobs: int,
+        pipeline_type: int,
         value_args,
         write_all_owner: dict[int, int],
     ) -> None:
@@ -265,7 +267,12 @@ class Cores:
         single = self.num_devices == 1
         try:
             if pipeline and blobs > 1:
-                self._run_pipelined(
+                engine = (
+                    self._run_pipelined_event
+                    if pipeline_type == PIPELINE_EVENT
+                    else self._run_pipelined_driver
+                )
+                engine(
                     w, kernel_names, params, compute_id, offset, size,
                     local_range, global_range, blobs, value_args, single,
                     write_all_owner,
@@ -321,7 +328,52 @@ class Cores:
         finally:
             w.end_bench(compute_id)
 
-    def _run_pipelined(
+    def _pipeline_prologue(self, w: Worker, params: Sequence[ClArray]):
+        """Shared per-call setup for both pipeline engines: residency
+        snapshot + up-front upload of non-blobbed arrays."""
+        # enqueue mode: snapshot residency BEFORE any uploads — a buffer
+        # created by blob 1 must not suppress blobs 2..N of the same call
+        resident = {id(p) for p in params if id(p) in w._buffers} if self.enqueue_mode else set()
+        # non-blobbed arrays (not partial) upload once up-front
+        for p in params:
+            fl = p.flags
+            reads = fl.read and not fl.write_only
+            if reads and not fl.partial_read:
+                if id(p) not in resident:
+                    w.upload(p, 0, 0, True)
+            elif not reads:
+                w.ensure_resident(p)
+        return resident
+
+    def _pipeline_epilogue(
+        self,
+        w: Worker,
+        params: Sequence[ClArray],
+        offset: int,
+        size: int,
+        write_all_owner: dict[int, int],
+        handles: list,
+    ) -> None:
+        """Shared tail: write_all readbacks / enqueue-mode deferral, then
+        join all in-flight D2H copies."""
+        for idx, p in enumerate(params):
+            fl = p.flags
+            if not (fl.write and not fl.read_only):
+                continue
+            if fl.write_all:
+                if w.index == write_all_owner.get(idx):
+                    if self.enqueue_mode:
+                        with self._lock:
+                            self._enqueued.append((w, p, 0, p.size, True))
+                    else:
+                        handles.append(w.download_async(p, 0, p.size, True))
+            elif self.enqueue_mode:
+                with self._lock:
+                    self._enqueued.append((w, p, offset, size, False))
+        for h in handles:
+            Worker.finish_download(h)
+
+    def _run_pipelined_driver(
         self,
         w: Worker,
         kernel_names: Sequence[str],
@@ -336,23 +388,17 @@ class Cores:
         single: bool,
         write_all_owner: dict[int, int],
     ) -> None:
-        """Blob-chunked overlap: issue blob k+1's H2D while blob k computes
-        (reference: the 3-queue event pipeline wavefront, Cores.cs:1252-1363)."""
+        """DRIVER engine: depth-first dispatch chains — blob k's full
+        H2D → compute → D2H is issued back-to-back with no host
+        synchronization, blob k+1's chain follows immediately (reference:
+        the driver-driven 16-queue pipeline, blob k → queue k mod 16 doing
+        R+C+W with no events, Cores.cs:1371-1858).  XLA's async dispatch
+        streams play the role of the 16 in-order queues: the transfer
+        engine runs blob k+1's DMA while the compute stream runs blob k."""
         blob = size // blobs
         if blob <= 0:
             blob, blobs = size, 1
-        # enqueue mode: snapshot residency BEFORE any uploads — a buffer
-        # created by blob 1 must not suppress blobs 2..N of the same call
-        resident = {id(p) for p in params if id(p) in w._buffers} if self.enqueue_mode else set()
-        # non-blobbed arrays (not partial) upload once up-front
-        for p in params:
-            fl = p.flags
-            reads = fl.read and not fl.write_only
-            if reads and not fl.partial_read:
-                if id(p) not in resident:
-                    w.upload(p, 0, 0, True)
-            elif not reads:
-                w.ensure_resident(p)
+        resident = self._pipeline_prologue(w, params)
         handles = []
         for k in range(blobs):
             boff = offset + k * blob
@@ -373,25 +419,84 @@ class Cores:
                 fl = p.flags
                 if fl.write and not fl.read_only and not fl.write_all:
                     if self.enqueue_mode:
-                        continue  # deferred below as one whole-range record
+                        continue  # deferred in the epilogue as one record
                     epw = fl.elements_per_work_item
                     handles.append(w.download_async(p, boff * epw, blob * epw, False))
-        for idx, p in enumerate(params):
-            fl = p.flags
-            if not (fl.write and not fl.read_only):
-                continue
-            if fl.write_all:
-                if w.index == write_all_owner.get(idx):
-                    if self.enqueue_mode:
-                        with self._lock:
-                            self._enqueued.append((w, p, 0, p.size, True))
-                    else:
-                        handles.append(w.download_async(p, 0, p.size, True))
-            elif self.enqueue_mode:
-                with self._lock:
-                    self._enqueued.append((w, p, offset, size, False))
-        for h in handles:
-            Worker.finish_download(h)
+        self._pipeline_epilogue(w, params, offset, size, write_all_owner, handles)
+
+    def _run_pipelined_event(
+        self,
+        w: Worker,
+        kernel_names: Sequence[str],
+        params: Sequence[ClArray],
+        compute_id: int,
+        offset: int,
+        size: int,
+        local_range: int,
+        global_range: int,
+        blobs: int,
+        value_args,
+        single: bool,
+        write_all_owner: dict[int, int],
+    ) -> None:
+        """EVENT engine: breadth-first 3-stage wavefront — at step j the
+        host *stages* blob j's H2D DMA (transfer starts immediately, no
+        device-side insert yet), *commits + computes* blob j-1, and starts
+        blob j-2's D2H (reference: the event-driven 3-queue pipeline whose
+        read/compute/write queues chain per-blob events,
+        Cores.cs:1236-1367).  Explicit dependency chaining: the commit
+        (dynamic_update_slice of the staged slice) is the device-side edge
+        from the read stage into the compute stage, so blob j's DMA always
+        has a full compute-step of latency to hide behind blob j-1's
+        kernels."""
+        blob = size // blobs
+        if blob <= 0:
+            blob, blobs = size, 1
+        resident = self._pipeline_prologue(w, params)
+        partials = [
+            p
+            for p in params
+            if p.flags.read
+            and not p.flags.write_only
+            and p.flags.partial_read
+            and id(p) not in resident
+        ]
+        writers = [
+            (idx, p)
+            for idx, p in enumerate(params)
+            if p.flags.write and not p.flags.read_only and not p.flags.write_all
+        ]
+        staged: dict[int, list] = {}
+        handles = []
+        for j in range(blobs + 2):
+            if j < blobs:  # read stage: start blob j's DMA
+                boff = offset + j * blob
+                staged[j] = [
+                    w.stage_upload(
+                        p,
+                        boff * p.flags.elements_per_work_item,
+                        blob * p.flags.elements_per_work_item,
+                    )
+                    for p in partials
+                ]
+            k = j - 1
+            if 0 <= k < blobs:  # compute stage: commit blob k, launch kernels
+                for s in staged.pop(k, ()):
+                    w.commit_upload(s)
+                if not self.no_compute_mode:
+                    w.launch(
+                        self.program, kernel_names, params, value_args,
+                        offset + k * blob, blob, local_range, global_range,
+                        local_range, repeats=self.repeat_count,
+                        sync_kernel=self.repeat_sync_kernel,
+                    )
+            m = j - 2
+            if 0 <= m < blobs and not self.enqueue_mode:  # write stage
+                boff = offset + m * blob
+                for idx, p in writers:
+                    epw = p.flags.elements_per_work_item
+                    handles.append(w.download_async(p, boff * epw, blob * epw, False))
+        self._pipeline_epilogue(w, params, offset, size, write_all_owner, handles)
 
     # -- enqueue-mode sync (reference: flushLastUsedCommandQueue / finish) ----
     def flush(self) -> None:
@@ -436,16 +541,24 @@ class Cores:
 
         Materializes one element per buffer: on tunneled backends (axon)
         ``block_until_ready`` can return before remote execution finishes,
-        so a 4-byte D2H is the reliable fence."""
+        so a 4-byte D2H is the reliable fence.
+
+        A device/kernel failure surfacing at the fence is REAL — it is
+        collected per buffer and the first one re-raised after all workers
+        have been fenced (a swallowed error here would let a failed
+        dispatch masquerade as a fast, wrong benchmark)."""
         import numpy as _np
 
+        errs: list[Exception] = []
         for w in self.workers:
             for buf in w._buffers.values():
                 try:
                     buf.block_until_ready()
                     _np.asarray(buf[:1])
-                except Exception:
-                    pass
+                except Exception as e:
+                    errs.append(e)
+        if errs:
+            raise errs[0]
 
     def ranges_of(self, compute_id: int) -> list[int]:
         return list(self.global_ranges.get(compute_id, []))

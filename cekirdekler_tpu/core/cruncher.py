@@ -142,7 +142,8 @@ class NumberCruncher:
         for w in self.cores.workers:
             if v and w.markers is None:
                 w.markers = MarkerCounter()
-            elif not v:
+            elif not v and w.markers is not None:
+                w.markers.close()
                 w.markers = None
 
     def count_markers_remaining(self) -> int:
@@ -163,6 +164,11 @@ class NumberCruncher:
 
     def performance_history(self, compute_id: int):
         return self.cores.performance_history(compute_id)
+
+    def reset_errors(self) -> None:
+        """Re-arm a cruncher after a compute failure (the reference has no
+        reset — a failed cruncher stays dead; we allow explicit recovery)."""
+        self.number_of_errors_happened = 0
 
     # -- sync / reporting ----------------------------------------------------
     def flush(self) -> None:

@@ -73,6 +73,9 @@ class Worker:
         # per-compute-id accumulated wall ms (reference: Worker.cs:190,753-807)
         self.benchmarks: dict[int, float] = {}
         self._bench_t0: dict[int, float] = {}
+        # last H2D transfer path taken ("dlpack-zero-copy" | "dlpack+move" |
+        # "staged-dma") — observability for the zero_copy flag
+        self.last_upload_path: str | None = None
         # fine-grained progress markers (reference: queue markers,
         # ClCommandQueue.cs:99-115); None unless enabled by the cruncher
         self.markers: MarkerCounter | None = None
@@ -97,24 +100,70 @@ class Worker:
             self._buffer_owner[key] = arr
         return buf
 
+    def _h2d(self, host_slice: np.ndarray, zero_copy: bool):
+        """One H2D transfer.  ``zero_copy`` requests the
+        ``CL_MEM_USE_HOST_PTR`` analogue (SURVEY.md §7): import the host
+        buffer via dlpack — genuinely zero-copy on the CPU backend when the
+        FastArr-aligned memory can be aliased — falling back to a direct
+        DMA from the (page-aligned, pinned-staging) host array otherwise."""
+        if zero_copy:
+            try:
+                x = jnp.from_dlpack(host_slice)
+                if self.device in x.devices():
+                    self.last_upload_path = "dlpack-zero-copy"
+                else:
+                    x = jax.device_put(x, self.device)
+                    self.last_upload_path = "dlpack+move"
+                return x
+            except Exception:
+                pass  # backend can't alias host memory — stage instead
+        self.last_upload_path = "staged-dma"
+        # numpy → target device directly: wrapping in jnp.asarray first
+        # would land on the default device and force a cross-device copy
+        return jax.device_put(host_slice, self.device)
+
     def upload(self, arr: ClArray, offset_elems: int, size_elems: int, full: bool) -> None:
         """H2D: full array or only this chip's range slice (reference:
         writeToBuffer / writeToBufferRanged, Worker.cs:821-885)."""
         key = id(arr)
         host = arr.host()
         if full:
-            # numpy → target device directly: wrapping in jnp.asarray first
-            # would land on the default device and force a cross-device copy
-            self._buffers[key] = jax.device_put(host, self.device)
+            buf = self._h2d(host, arr.flags.zero_copy)
+            self._buffers[key] = buf
             self._buffer_owner[key] = arr
+            if self.markers is not None:
+                self.markers.add()
+                self.markers.reach_when_ready(buf)
             return
         buf = self._buffer_for(arr)
         if self.markers is not None:
             self.markers.add()
-        sl = jax.device_put(host[offset_elems : offset_elems + size_elems], self.device)
-        self._buffers[key] = _update_slice(buf, sl, offset_elems)
+        sl = self._h2d(host[offset_elems : offset_elems + size_elems], arr.flags.zero_copy)
+        out = _update_slice(buf, sl, offset_elems)
+        self._buffers[key] = out
         if self.markers is not None:
-            self.markers.reach()
+            self.markers.reach_when_ready(out)
+
+    def stage_upload(self, arr: ClArray, offset_elems: int, size_elems: int):
+        """Start the H2D DMA for a range slice WITHOUT inserting it into the
+        chip's buffer yet — the event-pipeline engine stages blob j+1's
+        transfer while blob j computes (reference: the read queue of the
+        3-queue event pipeline, Cores.cs:1263-1295).  Returns a handle for
+        :meth:`commit_upload`."""
+        host = arr.host()
+        if self.markers is not None:
+            self.markers.add()
+        sl = self._h2d(host[offset_elems : offset_elems + size_elems], arr.flags.zero_copy)
+        if self.markers is not None:
+            self.markers.reach_when_ready(sl)
+        return (arr, sl, offset_elems)
+
+    def commit_upload(self, staged) -> None:
+        """Insert a staged slice into the range buffer (the device-side
+        dependency edge between the read queue and the compute queue)."""
+        arr, sl, off = staged
+        buf = self._buffer_for(arr)
+        self._buffers[id(arr)] = _update_slice(buf, sl, off)
 
     def ensure_resident(self, arr: ClArray) -> Any:
         """Buffer for a non-read array: reuse cache or zeros (the kernel is
@@ -154,32 +203,51 @@ class Worker:
         kernel between repeats (computeRepeatedWithSyncKernel)."""
         bufs = tuple(self._buffers[id(p)] for p in params)
         names = list(kernel_names)
-        if repeats > 1 and sync_kernel:
-            seq: list[str] = []
-            for r in range(repeats):
-                seq.extend(names)
-                if r != repeats - 1:
-                    seq.append(sync_kernel)
-            plan = [(seq, 1)]
+        dispatched = 0
+        seq_fn = None
+        if repeats > 1:
+            # on-device repeat: the whole sequence × repeats is ONE fused
+            # dispatch (lax.fori_loop inside jit) — no host round-trips
+            # (reference: computeRepeated, Worker.cs:36-46)
+            seq_fn = program.sequence_launcher(
+                tuple(names), tuple(_ladder(size, step)), local_range,
+                global_size, repeats, sync_kernel, value_args,
+            )
+        if seq_fn is not None:
+            bufs = tuple(seq_fn(offset, bufs))
+            dispatched = 1
         else:
-            plan = [(names, repeats)]
-
-        for names_seq, reps in plan:
-            for _ in range(reps):
-                for name in names_seq:
-                    va = value_args.get(name, ()) if isinstance(value_args, dict) else tuple(value_args)
-                    for chunk in _ladder(size, step):
-                        fn, info = program.launcher(name, chunk, local_range, global_size)
-                        n_arr = program.array_param_count(name)
-                        out = fn(offset, bufs[:n_arr], tuple(va))
-                        bufs = tuple(out) + bufs[n_arr:]
-                        offset += chunk
-                    offset -= size  # rewind for next kernel/repeat
+            # host-loop fallback (unhashable values): interleave the sync
+            # kernel between repeats like computeRepeatedWithSyncKernel
+            if repeats > 1 and sync_kernel:
+                seq: list[str] = []
+                for r in range(repeats):
+                    seq.extend(names)
+                    if r != repeats - 1:
+                        seq.append(sync_kernel)
+                plan = [(seq, 1)]
+            else:
+                plan = [(names, repeats)]
+            for names_seq, reps in plan:
+                for _ in range(reps):
+                    for name in names_seq:
+                        va = value_args.get(name, ()) if isinstance(value_args, dict) else tuple(value_args)
+                        for chunk in _ladder(size, step):
+                            fn, info = program.launcher(name, chunk, local_range, global_size)
+                            n_arr = program.array_param_count(name)
+                            out = fn(offset, bufs[:n_arr], tuple(va))
+                            bufs = tuple(out) + bufs[n_arr:]
+                            offset += chunk
+                            dispatched += 1
+                        offset -= size  # rewind for next kernel/repeat
         for p, b in zip(params, bufs):
             self._buffers[id(p)] = b
-        if self.markers is not None:
-            self.markers.add(len(kernel_names))
-            self.markers.reach(len(kernel_names))
+        if self.markers is not None and bufs:
+            # one marker per actual dispatch, reached when the sequence's
+            # final output retires on the chip (real in-flight depth, not
+            # host-dispatch counting) — repeat mode shows O(1) dispatches
+            self.markers.add(dispatched)
+            self.markers.reach_when_ready(bufs[0], dispatched)
 
     # -- readback ------------------------------------------------------------
     def download_async(self, arr: ClArray, offset_elems: int, size_elems: int, full: bool):
@@ -213,3 +281,6 @@ class Worker:
         self._buffers.clear()
         self._buffer_owner.clear()
         self.benchmarks.clear()
+        if self.markers is not None:
+            self.markers.close()
+            self.markers = None

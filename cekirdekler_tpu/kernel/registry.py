@@ -178,3 +178,77 @@ class KernelProgram:
         with self._lock:
             self._cache[key] = (jitted, info)
         return jitted, info
+
+    def sequence_launcher(
+        self,
+        names: tuple,
+        chunks: tuple,
+        local_size: int,
+        global_size: int,
+        repeats: int,
+        sync_kernel: str | None,
+        value_args,
+    ) -> Callable | None:
+        """One jitted function running the whole kernel sequence over the
+        launch ladder ``repeats`` times as an on-device ``lax.fori_loop`` —
+        O(1) dispatches regardless of repeat count (reference:
+        computeRepeated / computeRepeatedWithSyncKernel run the repeat loop
+        inside the native layer, Worker.cs:36-46, SURVEY.md §2.3).
+
+        Scalar values are baked as compile-time constants (part of the
+        cache key) — repeat mode recompiles when they change.  Returns
+        ``None`` when the values are unhashable (caller falls back to the
+        host loop).
+        """
+        from jax import lax
+
+        def vals_for(name: str) -> tuple:
+            if isinstance(value_args, dict):
+                return tuple(value_args.get(name, ()))
+            return tuple(value_args)
+
+        all_names = set(names) | ({sync_kernel} if sync_kernel else set())
+        try:
+            sig = tuple(sorted((n, vals_for(n)) for n in all_names))
+            key = ("seq", names, chunks, local_size, global_size, repeats, sync_kernel, sig)
+            with self._lock:
+                hit = self._cache.get(key)
+        except TypeError:
+            return None  # unhashable values (e.g. traced arrays)
+        if hit is not None:
+            return hit[0]
+
+        def run_names(names_seq, offset0, bufs):
+            for name in names_seq:
+                off = offset0
+                n_arr = self.array_param_count(name)
+                for chunk in chunks:
+                    fn, _ = self.launcher(name, chunk, local_size, global_size)
+                    out = fn(off, bufs[:n_arr], vals_for(name))
+                    bufs = tuple(out) + bufs[n_arr:]
+                    off = off + chunk
+            return bufs
+
+        def raw(offset, bufs: tuple):
+            bufs = tuple(bufs)
+            if repeats <= 1:
+                return run_names(names, offset, bufs)
+            if sync_kernel:
+                def body(_, b):
+                    b = run_names(names, offset, b)
+                    return run_names((sync_kernel,), offset, b)
+
+                bufs = lax.fori_loop(0, repeats - 1, body, bufs)
+                return run_names(names, offset, bufs)
+            return lax.fori_loop(
+                0, repeats, lambda _, b: run_names(names, offset, b), bufs
+            )
+
+        jitted = jax.jit(raw)
+        info = codegen.KernelBuildInfo(
+            name="+".join(names), array_params=[], value_params=[],
+            array_ctypes={}, stored_params=[],
+        )
+        with self._lock:
+            self._cache[key] = (jitted, info)
+        return jitted

@@ -156,6 +156,21 @@ class Transformer:
         )
 
     # -- forward -------------------------------------------------------------
+    def _embed_lookup(self, embed, tokens, mesh: Mesh | None):
+        """Token → embedding row.  Under a mesh the table is sharded
+        ``P("tp", "fsdp")`` (vocab over tp), so a plain gather forces GSPMD
+        to rematerialize the full table every step; the one-hot matmul form
+        is a contraction over the sharded vocab dim instead — XLA keeps the
+        shards in place and inserts one psum over tp (MXU-friendly)."""
+        c = self.config
+        if mesh is None or mesh.shape.get("tp", 1) <= 1:
+            # vocab dim unsharded: the gather is local and cheap — the
+            # one-hot contraction would cost O(B·T·vocab·D) for nothing
+            return embed.astype(c.dtype)[tokens]
+        onehot = jax.nn.one_hot(tokens, c.vocab, dtype=c.dtype)
+        onehot = constrain(onehot, mesh, ("dp", "fsdp"), c.sp_axis, "tp")
+        return onehot @ embed.astype(c.dtype)
+
     def _attention(self, q, k, v, mesh: Mesh | None):
         c = self.config
         if c.attention in ("ring", "ulysses") and mesh is not None:
@@ -208,16 +223,21 @@ class Transformer:
     def apply(self, params: dict, tokens, mesh: Mesh | None = None):
         """tokens [B, T] int32 → logits [B, T, vocab] (f32)."""
         c = self.config
-        x = params["embed"].astype(c.dtype)[tokens]
+        x = self._embed_lookup(params["embed"], tokens, mesh)
         if mesh is not None:
             x = constrain(x, mesh, ("dp", "fsdp"), c.sp_axis, None)
-        def block(bp, x):
-            return self._block(bp, x, mesh)
+        if c.pp_stages > 1:
+            # blocks is a stacked pytree (init, pp_stages>1 branch) — run it
+            # through the GPipe microbatch pipeline over the pp axis
+            x = self._apply_pipelined(params["blocks"], x, mesh)
+        else:
+            def block(bp, x):
+                return self._block(bp, x, mesh)
 
-        if c.remat:
-            block = jax.checkpoint(block)
-        for bp in params["blocks"]:
-            x = block(bp, x)
+            if c.remat:
+                block = jax.checkpoint(block)
+            for bp in params["blocks"]:
+                x = block(bp, x)
         x = _rms_norm(x, params["final_norm"])
         logits = x.astype(jnp.float32) @ params["embed"].astype(jnp.float32).T
         if mesh is not None:

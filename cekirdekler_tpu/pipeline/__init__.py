@@ -1,0 +1,14 @@
+from .device_pipeline import ArrayRole, ClPipeline, DevicePipeline, PipelineStage
+from .pool import ClDevicePool, ClTask, ClTaskPool, ClTaskType, PoolType
+
+__all__ = [
+    "ArrayRole",
+    "ClDevicePool",
+    "ClPipeline",
+    "ClTask",
+    "ClTaskPool",
+    "ClTaskType",
+    "DevicePipeline",
+    "PipelineStage",
+    "PoolType",
+]

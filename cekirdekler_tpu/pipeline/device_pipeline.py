@@ -23,6 +23,7 @@ ClPipeline.cs:114-122).
 
 from __future__ import annotations
 
+import enum
 import threading
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
@@ -36,7 +37,24 @@ from ..errors import CekirdeklerError, ComputeValidationError
 from ..hardware import Device
 from ..kernel.registry import KernelProgram
 
-__all__ = ["PipelineStage", "ClPipeline", "DevicePipeline"]
+__all__ = ["PipelineStage", "ClPipeline", "DevicePipeline", "ArrayRole"]
+
+
+class ArrayRole(enum.Enum):
+    """Single-device pipeline array semantics (reference:
+    DevicePipelineArrayType, ClPipeline.cs:3171-3206).
+
+    - ``INPUT``: host-fed each feed (stage 0 of the array's stage).
+    - ``OUTPUT``: host-read each feed.
+    - ``INTERNAL``: persists on the device across feeds, never leaves.
+    - ``TRANSITION``: written by its stage, consumed by the NEXT stage on
+      the following generation (the stage→stage link).
+    """
+
+    INPUT = "input"
+    OUTPUT = "output"
+    INTERNAL = "internal"
+    TRANSITION = "transition"
 
 
 @dataclass
@@ -75,6 +93,7 @@ class PipelineStage:
         self.inputs: list[_Slot] = []
         self.hiddens: list[_Slot] = []
         self.outputs: list[_Slot] = []
+        self.transitions: list[_Slot] = []
         self.device: Device | None = None
         self.prev: "PipelineStage | None" = None
         self.next: "PipelineStage | None" = None
@@ -93,6 +112,24 @@ class PipelineStage:
         self.outputs.extend(_Slot(wrap(a, **flags), "output") for a in arrays)
         return self
 
+    def add_transition(self, *arrays, **flags) -> "PipelineStage":
+        """Bind TRANSITION arrays: written by this stage, consumed by the
+        NEXT stage one generation later (reference:
+        DevicePipelineArrayType.TRANSITION, ClPipeline.cs:3171-3206).
+        The builder links the matching input slot onto the next stage."""
+        self.transitions.extend(_Slot(wrap(a, **flags), "transition") for a in arrays)
+        return self
+
+    def add_array(self, arr, role: "ArrayRole", **flags) -> "PipelineStage":
+        """Role-based binding (reference API shape)."""
+        if role is ArrayRole.INPUT:
+            return self.add_input(arr, **flags)
+        if role is ArrayRole.OUTPUT:
+            return self.add_output(arr, **flags)
+        if role is ArrayRole.INTERNAL:
+            return self.add_hidden(arr, **flags)
+        return self.add_transition(arr, **flags)
+
     # -- graph building (reference: prependToStage/appendToStage) ------------
     def append_to(self, prev: "PipelineStage") -> "PipelineStage":
         prev.next, self.prev = self, prev
@@ -104,7 +141,7 @@ class PipelineStage:
 
     # -- execution -----------------------------------------------------------
     def _slots(self) -> list[_Slot]:
-        return self.inputs + self.hiddens + self.outputs
+        return self.inputs + self.hiddens + self.outputs + self.transitions
 
     def _bind(self, jdev) -> None:
         import jax.numpy as jnp
@@ -180,6 +217,24 @@ class ClPipeline:
                     raise ComputeValidationError(
                         f"stage {i} array '{s.arr.name}' smaller than global range"
                     )
+        # wire TRANSITION links: the producing stage's transition slot feeds
+        # the slot in the NEXT stage bound to the same ClArray object
+        for i, st in enumerate(stages):
+            st._transition_links = []
+            for t in st.transitions:
+                if st.next is None:
+                    raise ComputeValidationError(
+                        f"stage {i} declares transition '{t.arr.name}' but has no next stage"
+                    )
+                target = next(
+                    (s for s in st.next._slots() if s.arr is t.arr), None
+                )
+                if target is None:
+                    raise ComputeValidationError(
+                        f"transition '{t.arr.name}' of stage {i} is not bound "
+                        f"on stage {i + 1} (declare it there as input/internal)"
+                    )
+                st._transition_links.append((t, target))
         for st in stages:
             if st.init_kernels:
                 st._run(st.init_kernels)
@@ -225,16 +280,29 @@ class ClPipeline:
                 target = r.host() if isinstance(r, ClArray) else r
                 np.copyto(target, np.asarray(slot.value), casting="unsafe")
 
-        # forward outputs device→device into the next stage's inputs
-        # (ICI transfer; replaces the reference's host-hop forwardResults)
+        self._switch()
+        self.push_count += 1
+        return self.push_count >= len(self.stages)
+
+    def _switch(self) -> None:
+        """Advance generation links (the reference's switchBuffers +
+        forwardResults, ClPipeline.cs:87-111,624-1580): explicit TRANSITION
+        links move first; stages without transitions fall back to by-index
+        output→input forwarding.  Same-chip handoff is a free value move;
+        cross-chip rides ICI via ``device_put``."""
         for st in self.stages[:-1]:
             nxt = st.next
+            links = getattr(st, "_transition_links", [])
+            if links:
+                for src, dst in links:
+                    v = src.value
+                    if st.device is not nxt.device:
+                        v = jax.device_put(v, nxt.device.jax_device)
+                    dst.value = v
+                continue
             n = min(len(st.outputs), len(nxt.inputs))
             for o_slot, i_slot in zip(st.outputs[:n], nxt.inputs[:n]):
                 i_slot.value = jax.device_put(o_slot.value, nxt.device.jax_device)
-
-        self.push_count += 1
-        return self.push_count >= len(self.stages)
 
     def performance_report(self) -> str:
         lines = ["pipeline stages:"]
@@ -255,13 +323,88 @@ class ClPipeline:
 class DevicePipeline(ClPipeline):
     """Single-chip N-stage pipeline (reference: SingleGPUPipeline.
     DevicePipeline, ClPipeline.cs:2357-3240) — same generation semantics,
-    every stage on ONE chip; concurrency comes from XLA async dispatch
-    (replacing the reference's enqueue-mode queue rotation)."""
+    every stage on ONE chip; device-side concurrency comes from XLA async
+    dispatch (replacing the reference's enqueue-mode queue rotation), and
+    HOST-side overlap comes from the ``feed_async_begin``/``feed_async_end``
+    pair: the device generation runs on a background thread while the
+    caller prepares the next feed's data (reference: feedAsync /
+    feedAsyncBegin/End, ClPipeline.cs:2598-2641).
+
+    Array roles (:class:`ArrayRole`) map the reference's
+    DevicePipelineArrayType semantics (ClPipeline.cs:3171-3206): INPUT is
+    host-fed, OUTPUT host-read, INTERNAL device-resident, TRANSITION
+    carries data stage→stage one generation later.
+    """
+
+    def __init__(self, stages: list[PipelineStage]):
+        super().__init__(stages)
+        self._async_future = None
 
     @classmethod
     def make(cls, stages: Sequence[PipelineStage], device: Device) -> "DevicePipeline":
         return super().make(stages, [device])
 
     def feed(self, data=None, results=None) -> bool:
-        """Reference naming (feed ≙ push, ClPipeline.cs:2577-2593)."""
+        """Synchronous generation (reference: feed, ClPipeline.cs:2577-2593)."""
         return self.push(data, results)
+
+    # -- async host-overlap feeds (reference: ClPipeline.cs:2598-2641) -------
+    def _generation(self, snaps) -> None:
+        """One device generation: upload snapshots, run every stage, switch
+        links.  Runs on a background thread for the async feeds."""
+        first = self.stages[0]
+        if snaps is not None:
+            for slot, host in zip(first.inputs, snaps):
+                slot.value = jax.device_put(host, first.device.jax_device)
+        for st in self.stages:
+            st._run(st.kernels)
+        self._switch()
+
+    def feed_async_begin(self, data=None) -> None:
+        """Kick off this generation on a background thread and return
+        immediately — the host thread is free to prepare the next feed
+        (the overlap the reference gets from async enqueue + Parallel.For
+        host copies).  Input data is snapshotted NOW, so the caller may
+        mutate its arrays right after this returns."""
+        if self._async_future is not None:
+            raise CekirdeklerError(
+                "feed_async_begin called again before feed_async_end"
+            )
+        snaps = None
+        if data is not None:
+            datas = list(data) if isinstance(data, (list, tuple)) else [data]
+            if len(datas) != len(self.stages[0].inputs):
+                raise ComputeValidationError(
+                    f"push data count {len(datas)} != stage-0 inputs "
+                    f"{len(self.stages[0].inputs)}"
+                )
+            snaps = [
+                np.array(d.host() if isinstance(d, ClArray) else d)
+                for d in datas
+            ]
+        self._async_future = self._pool.submit(self._generation, snaps)
+
+    def feed_async_end(self, results=None) -> bool:
+        """Join the in-flight generation and read back the last stage's
+        outputs.  Returns True once results are valid."""
+        if self._async_future is None:
+            raise CekirdeklerError("feed_async_end without feed_async_begin")
+        fut, self._async_future = self._async_future, None
+        fut.result()
+        if results is not None:
+            last = self.stages[-1]
+            outs = list(results) if isinstance(results, (list, tuple)) else [results]
+            if len(outs) != len(last.outputs):
+                raise ComputeValidationError(
+                    f"results count {len(outs)} != last-stage outputs {len(last.outputs)}"
+                )
+            for slot, r in zip(last.outputs, outs):
+                target = r.host() if isinstance(r, ClArray) else r
+                np.copyto(target, np.asarray(slot.value), casting="unsafe")
+        self.push_count += 1
+        return self.push_count >= len(self.stages)
+
+    def feed_async(self, data=None, results=None) -> bool:
+        """begin + end composed (reference: feedAsync)."""
+        self.feed_async_begin(data)
+        return self.feed_async_end(results)

@@ -29,6 +29,7 @@ import enum
 import itertools
 import queue
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Sequence
 
@@ -150,7 +151,14 @@ class ClTaskPool:
 class _Consumer(threading.Thread):
     """Per-chip consumer (reference: DevicePoolThread,
     ClPipeline.cs:4740-5080): private cruncher, greedy pulls from the shared
-    pipe plus a pinned queue for device-selected/broadcast tasks."""
+    pipe plus a pinned queue for device-selected/broadcast tasks.
+
+    With ``fine_grained_queue_control`` on, the consumer throttles on real
+    in-flight depth — it claims no new task while
+    ``count_markers_remaining() >= queue_limit`` (reference:
+    ``markersRemaining() < queueLimit`` gating, ClPipeline.cs:4899-4909).
+    Markers retire on actual device completion (utils/markers.py), so this
+    bounds device work in flight, not host dispatch."""
 
     def __init__(self, pool: "ClDevicePool", device: Device, index: int):
         super().__init__(daemon=True, name=f"devpool-{index}")
@@ -159,14 +167,28 @@ class _Consumer(threading.Thread):
         self.index = index
         self.pinned: "queue.Queue[ClTask | None]" = queue.Queue()
         self.cruncher = NumberCruncher(Devices([device]), pool.kernel_source)
+        if pool.fine_grained_queue_control:
+            self.cruncher.fine_grained_queue_control = True
         self.tasks_done = 0
+        self.max_inflight_seen = 0
         self._halt = False
+
+    def _throttle(self) -> None:
+        if not self.pool.fine_grained_queue_control:
+            return
+        while not self._halt:
+            depth = self.cruncher.count_markers_remaining()
+            self.max_inflight_seen = max(self.max_inflight_seen, depth)
+            if depth < self.pool.queue_limit:
+                return
+            time.sleep(0.0005)
 
     def run(self) -> None:  # pragma: no cover - exercised via pool tests
         while not self._halt:
-            # claim up to max_queues_per_device tasks per wake (the
-            # reference's per-device queue depth, ClPipeline.cs:3933-3980)
-            # and run them back-to-back
+            # claim up to the ADAPTIVE queue depth per wake (the reference's
+            # pool-progress heuristic shrinks per-device claims as the pool
+            # drains so the tail stays balanced, ClPipeline.cs:4188-4230)
+            self._throttle()
             batch: list[ClTask] = []
             try:
                 batch.append(self.pinned.get_nowait())
@@ -175,19 +197,24 @@ class _Consumer(threading.Thread):
                     batch.append(self.pool._pipe.get(timeout=0.05))
                 except queue.Empty:
                     continue
-            while len(batch) < self.pool.max_queues_per_device:
+            while len(batch) < self.pool._adaptive_depth():
                 try:
                     batch.append(self.pool._pipe.get_nowait())
                 except queue.Empty:
                     break
             for task in batch:
                 try:
+                    self._throttle()
                     task.compute(self.cruncher)
                     self.tasks_done += 1
                     if task.callback is not None:
                         task.callback(task)
                 except Exception as e:  # surface through the pool
                     self.pool._errors.append(e)
+                    # one bad task must not poison this chip's private
+                    # cruncher for the remaining tasks (the per-compute
+                    # error gate is for user-owned crunchers)
+                    self.cruncher.reset_errors()
                 finally:
                     self.pool._done_one()
 
@@ -210,7 +237,15 @@ class ClDevicePool:
         kernel_source,
         pool_type: PoolType = PoolType.DEVICE_COMPUTE_AT_WILL,
         max_queues_per_device: int = 4,
+        fine_grained_queue_control: bool = False,
+        queue_limit: int = 8,
+        backpressure: int = 0,
     ):
+        """``fine_grained_queue_control`` enables marker-based in-flight
+        throttling per chip with ``queue_limit`` as the depth bound
+        (reference: ClPipeline.cs:4899-4909).  ``backpressure`` bounds the
+        shared pipe (producer blocks when full; 0 = auto: 8 slots per
+        device) so a task storm cannot enqueue unboundedly."""
         if pool_type is not PoolType.DEVICE_COMPUTE_AT_WILL:
             raise CekirdeklerError(
                 "only DEVICE_COMPUTE_AT_WILL is implemented (the reference's "
@@ -218,7 +253,10 @@ class ClDevicePool:
             )
         self.kernel_source = kernel_source
         self.max_queues_per_device = max_queues_per_device
-        self._pipe: "queue.Queue[ClTask]" = queue.Queue()
+        self.fine_grained_queue_control = fine_grained_queue_control
+        self.queue_limit = max(1, queue_limit)
+        cap = backpressure if backpressure > 0 else 8 * max(1, len(devices))
+        self._pipe: "queue.Queue[ClTask]" = queue.Queue(maxsize=cap)
         self._pools: "queue.Queue[ClTaskPool]" = queue.Queue()
         self._errors: list[Exception] = []
         self._inflight = 0
@@ -230,6 +268,15 @@ class ClDevicePool:
         self._producer = threading.Thread(target=self._produce, daemon=True, name="devpool-producer")
         self._running = True
         self._producer.start()
+
+    def _adaptive_depth(self) -> int:
+        """Per-wake claim depth from pool progress: claim deep while much
+        work remains, shrink to 1 near the tail so the last tasks spread
+        across chips (reference heuristic, ClPipeline.cs:4188-4230)."""
+        with self._inflight_lock:
+            remaining = self._inflight
+        n = max(1, len(self._consumers))
+        return max(1, min(self.max_queues_per_device, remaining // (2 * n)))
 
     # -- device management ---------------------------------------------------
     def _add_consumer(self, device: Device) -> None:
@@ -248,6 +295,12 @@ class ClDevicePool:
 
     def tasks_done_per_device(self) -> list[int]:
         return [c.tasks_done for c in self._consumers]
+
+    def max_inflight_depth(self) -> int:
+        """Largest marker-observed in-flight depth any chip reached — with
+        fine-grained control on, bounded by ``queue_limit`` + one task's
+        dispatch burst."""
+        return max((c.max_inflight_seen for c in self._consumers), default=0)
 
     # -- accounting ----------------------------------------------------------
     def _dispatch_one(self) -> None:

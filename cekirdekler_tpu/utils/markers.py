@@ -7,15 +7,24 @@ giving in-flight depth and a smoothed 'marker reach speed' used by the
 pool scheduler for throttling (ClCommandQueue.cs:99-115,
 ClNumberCruncher.cs:356-372, ClPipeline.cs:4788-4827).  The TPU analogue
 counts dispatched vs retired operations per lane: XLA dispatch is async,
-so 'reached' means the op's result became ready (host callback /
-``block_until_ready`` completion).
+so 'reached' means the op's result became ready — :meth:`reach_when_ready`
+joins ``block_until_ready`` on a completion thread, the PJRT-side
+equivalent of the reference's queue-completion callback.
+
+The added/reached counts live in the native C++ counter
+(native/kutuphane_tpu.cpp ck_createMarkerCounter et al.) when the library
+is available — the same native-callback-counter architecture as the
+reference — with a pure-Python fallback.
 """
 
 from __future__ import annotations
 
+import queue
 import threading
 import time
 from collections import deque
+
+from ..native import load as _load_native
 
 __all__ = ["MarkerCounter"]
 
@@ -23,42 +32,121 @@ __all__ = ["MarkerCounter"]
 class MarkerCounter:
     """Dispatched/retired op counting + smoothed retire rate.
 
-    ``add()`` marks a dispatch; ``reach()`` marks completion.  The rate
-    estimate averages the last ``window`` retire intervals (the
-    reference's 15-sample markerReachSpeed smoothing,
+    ``add()`` marks a dispatch; ``reach()`` marks completion *now*;
+    ``reach_when_ready(x)`` marks completion when the device value ``x``
+    actually retires.  The rate estimate averages the last ``window``
+    retire intervals (the reference's 15-sample markerReachSpeed smoothing,
     ClPipeline.cs:4788-4817).
     """
 
     def __init__(self, window: int = 15):
         self._lock = threading.Lock()
-        self._added = 0
-        self._reached = 0
         self._times: deque[float] = deque(maxlen=window)
+        self._completions: "queue.Queue" = queue.Queue()
+        self._completion_thread: threading.Thread | None = None
+        self._closed = False
+        self._native = _load_native()
+        if self._native is not None:
+            self._nid = self._native.ck_createMarkerCounter()
+        else:
+            self._nid = None
+            self._added = 0
+            self._reached = 0
 
+    def close(self) -> None:
+        """Stop the completion thread and release the native counter."""
+        self._closed = True
+        t = self._completion_thread
+        if t is not None:
+            self._completions.put(None)
+            t.join(timeout=2.0)
+            self._completion_thread = None
+        if self._nid is not None and self._native is not None:
+            self._native.ck_deleteMarkerCounter(self._nid)
+            self._nid = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    # -- counting ------------------------------------------------------------
     def add(self, n: int = 1) -> None:
-        with self._lock:
-            self._added += n
+        if self._nid is not None:
+            for _ in range(n):
+                self._native.ck_addMarker(self._nid)
+        else:
+            with self._lock:
+                self._added += n
 
     def reach(self, n: int = 1) -> None:
         now = time.perf_counter()
+        if self._nid is not None:
+            for _ in range(n):
+                self._native.ck_markerReached(self._nid)
+        else:
+            with self._lock:
+                self._reached += n
         with self._lock:
-            self._reached += n
             self._times.append(now)
 
+    def reach_when_ready(self, x, n: int = 1) -> None:
+        """Reach when ``x`` (a jax.Array or any object with
+        ``block_until_ready``) retires on the device — joined on a
+        completion thread so in-flight depth reflects real device work,
+        not host dispatch."""
+        if self._completion_thread is None:
+            with self._lock:
+                if self._completion_thread is None and not self._closed:
+                    # daemon: a hung device must not block interpreter exit
+                    self._completion_thread = threading.Thread(
+                        target=self._drain_completions,
+                        name="marker-reach",
+                        daemon=True,
+                    )
+                    self._completion_thread.start()
+        self._completions.put((x, n))
+
+    def _drain_completions(self) -> None:
+        while True:
+            item = self._completions.get()
+            if item is None:
+                return
+            x, n = item
+            try:
+                x.block_until_ready()
+            except Exception:
+                pass  # a failed op still retires the marker
+            self.reach(n)
+
+    # -- queries -------------------------------------------------------------
     @property
     def added(self) -> int:
+        if self._nid is not None:
+            return int(self._native.ck_markersAdded(self._nid))
         with self._lock:
             return self._added
 
     @property
     def reached(self) -> int:
+        if self._nid is not None:
+            return int(self._native.ck_markersReached(self._nid))
         with self._lock:
             return self._reached
 
     def remaining(self) -> int:
         """In-flight depth (reference: countMarkersRemaining)."""
+        if self._nid is not None:
+            return int(self._native.ck_markersRemaining(self._nid))
         with self._lock:
             return self._added - self._reached
+
+    def drain(self, timeout: float = 30.0) -> None:
+        """Block until every added marker has reached (bounded)."""
+        deadline = time.perf_counter() + timeout
+        while self.remaining() > 0 and time.perf_counter() < deadline:
+            time.sleep(0.0005)
 
     def reach_speed(self) -> float:
         """Retired ops/second over the smoothing window (0 if <2 samples)."""
@@ -69,7 +157,11 @@ class MarkerCounter:
             return (len(self._times) - 1) / span if span > 0 else 0.0
 
     def reset(self) -> None:
+        if self._nid is not None:
+            self._native.ck_resetMarkerCounter(self._nid)
+        else:
+            with self._lock:
+                self._added = 0
+                self._reached = 0
         with self._lock:
-            self._added = 0
-            self._reached = 0
             self._times.clear()

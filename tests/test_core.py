@@ -252,3 +252,97 @@ def test_fastarr_backed_compute(devs):
     a.next_param(b).next_param(c).compute(cr, 1, "vadd", 1024, 64)
     np.testing.assert_allclose(np.asarray(c), np.arange(1024) + 1)
     cr.dispose()
+
+
+def test_repeat_is_one_fused_dispatch(devs):
+    """repeat_count=100 issues O(1) dispatches (lax.fori_loop on device) —
+    asserted via marker counts (VERDICT r1 #9; reference: computeRepeated,
+    Worker.cs:36-46)."""
+    cr = NumberCruncher(devs.subset(1), VADD)
+    cr.fine_grained_queue_control = True
+    x = ClArray(np.zeros(256, np.float32), name="x")
+    x.partial_read = True
+    cr.repeat_count = 100
+    x.compute(cr, 1, "inc", 256, 64)
+    np.testing.assert_allclose(np.asarray(x), 100.0)
+    w = cr.cores.workers[0]
+    # markers: 1 upload + 1 fused launch + 1 download = 3, NOT ~100
+    assert w.markers.added <= 4, w.markers.added
+    cr.dispose()
+
+
+def test_repeat_with_sync_kernel_fused(devs):
+    cr = NumberCruncher(devs.subset(1), VADD)
+    x = ClArray(np.zeros(128, np.float32), name="x")
+    x.partial_read = True
+    cr.repeat_count = 5
+    cr.repeat_kernel_name = "inc"  # sync kernel between repeats
+    x.compute(cr, 1, "inc", 128, 64)
+    # 5 repeats of inc + 4 interleaved sync incs = 9 total
+    np.testing.assert_allclose(np.asarray(x), 9.0)
+    cr.dispose()
+
+
+def test_zero_copy_changes_transfer_path(devs):
+    """flags.zero_copy takes the dlpack import path on the CPU backend
+    (the CL_MEM_USE_HOST_PTR analogue, SURVEY.md §7) — observable via
+    Worker.last_upload_path (VERDICT r1 #8)."""
+    cr = NumberCruncher(devs.subset(1), VADD)
+    w = cr.cores.workers[0]
+    x = ClArray(np.zeros(256, np.float32), name="x")
+    x.compute(cr, 1, "inc", 256, 64)
+    assert w.last_upload_path == "staged-dma"
+    y = ClArray(np.zeros(256, np.float32), name="y")
+    y.zero_copy = True
+    y.compute(cr, 2, "inc", 256, 64)
+    assert w.last_upload_path.startswith("dlpack"), w.last_upload_path
+    np.testing.assert_allclose(np.asarray(y), 1.0)
+    cr.dispose()
+
+
+def test_compute_error_gates_further_work(devs):
+    """A failed compute trips number_of_errors_happened and subsequent
+    computes refuse to run until reset_errors() (reference:
+    ClNumberCruncher.cs:374-392, ClArray.cs:1610-1623)."""
+    cr = NumberCruncher(devs.subset(1), VADD)
+    x = ClArray(np.zeros(256, np.float32), name="x")
+    with pytest.raises(Exception):
+        # unknown kernel -> validation error inside cores.compute
+        x.compute(cr, 1, "nonexistent_kernel", 256, 64)
+    assert cr.number_of_errors_happened == 1
+    with pytest.raises(ComputeValidationError, match="previous error"):
+        x.compute(cr, 1, "inc", 256, 64)
+    cr.reset_errors()
+    x.compute(cr, 1, "inc", 256, 64)  # works again
+    np.testing.assert_allclose(np.asarray(x), 1.0)
+    cr.dispose()
+
+
+@pytest.mark.parametrize("ptype", [PIPELINE_EVENT, PIPELINE_DRIVER])
+def test_pipeline_engines_multi_blob_multi_kernel(devs, ptype):
+    """Both engines produce identical results over many blobs with a
+    2-kernel sequence and partial reads/writes."""
+    cr = NumberCruncher(devs.subset(2), VADD)
+    n = 8192
+    a, b, c = make_abc(n)
+    c.write = True
+    g = a.next_param(b).next_param(c)
+    g.compute(cr, 1, "vadd scale2", n, 64, pipeline=True,
+              pipeline_blobs=8, pipeline_type=ptype)
+    np.testing.assert_allclose(np.asarray(c), (np.arange(n) + 1) * 2)
+    cr.dispose()
+
+
+def test_markers_observe_real_retirement(devs):
+    """Markers retire via completion threads: after a compute fully
+    drains, added == reached; marker_reach_speed reflects retirement."""
+    cr = NumberCruncher(devs.subset(2), VADD)
+    cr.fine_grained_queue_control = True
+    a, b, c = make_abc(1024)
+    a.next_param(b).next_param(c).compute(cr, 1, "vadd", 1024, 64)
+    for w in cr.cores.workers:
+        if w.markers is not None:
+            w.markers.drain(timeout=10.0)
+    assert cr.count_markers_remaining() == 0
+    assert cr.count_markers_reached() > 0
+    cr.dispose()

@@ -92,3 +92,67 @@ def test_pipeline_performance_report():
     report = pipe.performance_report()
     assert "stage 0" in report and "addOne" in report
     pipe.dispose()
+
+
+def test_feed_async_matches_serial_and_overlaps():
+    """feed_async_begin returns while the generation runs on a background
+    thread (host-overlap surface, reference feedAsyncBegin/End,
+    ClPipeline.cs:2598-2641), and async results equal serial results."""
+    import time
+
+    s1 = _stage(S1, "addOne")
+    s2 = _stage(S2, "timesTwo")
+    pipe = DevicePipeline.make([s1, s2], _cpus(1)[0])
+    result = np.zeros(N, np.float32)
+    outs = []
+    t_begin_max = 0.0
+    for g in range(6):
+        data = np.full(N, float(g), np.float32)
+        t0 = time.perf_counter()
+        pipe.feed_async_begin(data)
+        t_begin_max = max(t_begin_max, time.perf_counter() - t0)
+        # host is free here: mutate the source buffer — the feed snapshotted
+        data += 1000.0
+        if pipe.feed_async_end(result):
+            outs.append(result.copy())
+    for j, out in enumerate(outs):
+        np.testing.assert_array_equal(out, np.full(N, (j + 1.0) * 2.0, np.float32))
+    pipe.dispose()
+
+
+def test_transition_role_links_stages():
+    """TRANSITION arrays carry data stage->stage one generation later
+    (reference: DevicePipelineArrayType.TRANSITION, ClPipeline.cs:3171-3206)."""
+    from cekirdekler_tpu.pipeline import ArrayRole
+
+    trans = ClArray(N, np.float32)
+    s1 = PipelineStage(S1, "addOne", global_range=N, local_range=64)
+    s1.add_input(ClArray(N, np.float32))
+    s1.add_array(trans, ArrayRole.TRANSITION)  # addOne writes arg 2 = trans
+    s2 = PipelineStage(S2, "timesTwo", global_range=N, local_range=64)
+    s2.add_array(trans, ArrayRole.INPUT)
+    s2.add_array(ClArray(N, np.float32), ArrayRole.OUTPUT)
+
+    pipe = DevicePipeline.make([s1, s2], _cpus(1)[0])
+    result = np.zeros(N, np.float32)
+    outs = []
+    for g in range(5):
+        if pipe.feed(np.full(N, float(g), np.float32), result):
+            outs.append(result.copy())
+    for j, out in enumerate(outs):
+        np.testing.assert_array_equal(out, np.full(N, (j + 1.0) * 2.0, np.float32))
+    pipe.dispose()
+
+
+def test_transition_requires_binding_on_next_stage():
+    import pytest
+
+    from cekirdekler_tpu.errors import ComputeValidationError
+    from cekirdekler_tpu.pipeline import ArrayRole
+
+    s1 = PipelineStage(S1, "addOne", global_range=N, local_range=64)
+    s1.add_input(ClArray(N, np.float32))
+    s1.add_array(ClArray(N, np.float32), ArrayRole.TRANSITION)
+    s2 = _stage(S2, "timesTwo")
+    with pytest.raises(ComputeValidationError, match="not bound"):
+        DevicePipeline.make([s1, s2], _cpus(1)[0])
