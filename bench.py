@@ -1,26 +1,119 @@
 #!/usr/bin/env python
 """Headline benchmark: mandelbrot throughput (Mpixels/sec) across all
 available chips with iterative load balancing — BASELINE.md's primary
-metric.
+metric — plus the honest-accounting metrics VERDICT r1 asked for.
 
 Prints exactly one JSON line:
-  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...extras}
 
-``vs_baseline`` is measured against the unscheduled path on one chip (no
-load balancing across chips, no transfer/compute overlap) — the reference
-repo publishes no absolute numbers (BASELINE.md), so the baseline is the
-same workload without the framework's scheduling, i.e. the quantity its
-pipelining/balancing claims (Cores.cs:467) are about.
+Accounting (VERDICT r1 #3/#5):
+- ``vs_baseline``: framework vs the naive unscheduled loop (one chip, full
+  D2H + host sync per iteration) — the r1-continuity number; it mostly
+  measures what the enqueue/overlap machinery removes.
+- ``vs_tuned_loop``: framework vs a HAND-WRITTEN jit'd Pallas loop with the
+  SAME readback policy (image resident in HBM, fence every 16 iters).
+  This is the claim that matters: ~1.0 means the framework's scheduling
+  adds no overhead over the best raw-JAX loop a user could write.
+- ``overlap_fraction``: measured read/compute/write overlap of the
+  pipelined path on a transfer-bound stream (BASELINE.md target >= 0.9),
+  from isolated-phase timing vs the pipelined total.
+- ``gflops`` + roofline note: mandelbrot is VPU (elementwise) work —
+  FLOPs = pixels x mean escape iterations x ~10 flop/iter; it cannot be
+  judged against the MXU matmul peak.
+- ``hbm_stream_gbps`` / ``hbm_utilization``: device-resident c = a + b
+  (jit, donated, 12 bytes moved/elem) against the v5e HBM roofline
+  (~819 GB/s) — the memory-bound ceiling the chip actually has.
 """
 
 import json
 import sys
 import time
 
+V5E_HBM_GBPS = 819.0  # v5e HBM bandwidth roofline (public spec)
+FLOP_PER_MANDEL_ITER = 10.0  # zx2,zy2,cmp-add,t(2),zy(3),count(1),|z|(1)
+
+
+def _fence(x) -> None:
+    """Reliable device fence: materialize 4 bytes.  On tunneled backends
+    (axon) ``block_until_ready`` can return before remote execution
+    finishes — an unfenced timing loop measures dispatch rate, not device
+    throughput (it reads 100x too fast)."""
+    import numpy as np
+
+    np.asarray(x[:1])
+
+
+def tuned_pallas_loop(dev, width, height, max_iter, iters, warmup, sync_every=16):
+    """Best-effort raw-JAX/Pallas mandelbrot loop: no framework, image
+    stays in HBM, host fences (real 4-byte D2H, same fence as the
+    framework's barrier) every ``sync_every`` iterations — the competent
+    hand-written loop the framework must not lose to."""
+    import jax
+
+    from cekirdekler_tpu.ops.mandelbrot import mandelbrot_pallas
+
+    n = width * height
+    args = dict(
+        n=n, x0=-2.0, y0=-1.25, dx=2.5 / width, dy=2.5 / height,
+        width=width, max_iter=max_iter,
+        interpret=jax.default_backend() != "tpu",
+    )
+    out = mandelbrot_pallas(**args)  # compile + warm
+    _fence(out)
+    times = []
+    for k in range(warmup + iters):
+        t0 = time.perf_counter()
+        out = mandelbrot_pallas(**args)
+        if (k + 1) % sync_every == 0 or k == warmup + iters - 1:
+            _fence(out)
+        if k >= warmup:
+            times.append((time.perf_counter() - t0) * 1000.0)
+        elif k == warmup - 1:
+            _fence(out)  # warmup work retires outside the timed window
+    return (n * len(times)) / (sum(times) / 1000.0) / 1e6, out
+
+
+def hbm_stream(dev):
+    """Device-resident stream add: HBM-bandwidth roofline utilization.
+    K sequential passes inside one jit amortize the host-fence latency
+    (a per-rep fence on a tunneled backend measures RTT, not bandwidth)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    n = 1 << 24  # 64 MiB/array: well past VMEM, HBM-bound
+    K = 32
+    a = jax.device_put(jnp.arange(n, dtype=jnp.float32), dev)
+    b = jax.device_put(jnp.full((n,), 1e-9, jnp.float32), dev)
+
+    @jax.jit
+    def chain(a, b):
+        # each iteration reads y and b and writes y: 12 bytes/elem/pass
+        return lax.fori_loop(0, K, lambda i, y: y + b, a)
+
+    out = chain(a, b)
+    _fence(out)
+    # tunnel round-trip baseline: fencing an already-ready value costs one
+    # RTT with zero device work; subtract it so the quotient is bandwidth,
+    # not latency
+    rtt = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        _fence(out)
+        rtt = min(rtt, time.perf_counter() - t0)
+    reps, best = 3, float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        _fence(chain(a, b))
+        best = min(best, time.perf_counter() - t0)
+    return (K * 3 * 4 * n) / max(best - rtt, 1e-9) / 1e9
+
 
 def main() -> None:
+    import numpy as np
+
     import cekirdekler_tpu as ct
-    from cekirdekler_tpu.workloads import run_mandelbrot
+    from cekirdekler_tpu.workloads import measure_stream_overlap, run_mandelbrot
 
     devs = ct.all_devices()
     tpus = devs.tpus()
@@ -29,27 +122,59 @@ def main() -> None:
     width = height = 2048
     max_iter = 256
 
-    # Baseline: the naive unscheduled loop — kernel-language program on one
-    # chip, full image D2H + host sync every iteration (what a user gets
-    # without the framework's enqueue/overlap machinery).
+    # Baseline 1: the naive unscheduled loop — kernel-language program on
+    # one chip, full image D2H + host sync every iteration.
     base = run_mandelbrot(
         devs.subset(1), width=width, height=height, max_iter=max_iter,
         iters=6, warmup=2, pipeline=False,
     )
 
-    # Framework path: hand-tiled Pallas kernel through the same compute()
-    # scheduler, enqueue mode keeps the image in HBM (one flush at the end),
-    # 16-deep dispatch chains amortize sync latency.
+    # Baseline 2: hand-written jit'd Pallas loop, same readback policy as
+    # the framework path below.
+    tuned_mpix, tuned_img = tuned_pallas_loop(
+        devs[0].jax_device, width, height, max_iter, iters=32, warmup=4,
+    )
+
+    # Framework path: hand-tiled Pallas kernel through the compute()
+    # scheduler, enqueue mode keeps the image in HBM (one flush at the
+    # end), 16-deep dispatch chains amortize sync latency.
     full = run_mandelbrot(
         devs, width=width, height=height, max_iter=max_iter,
         iters=32, warmup=4, use_pallas=True, readback="final", sync_every=16,
+        keep_image=True,
     )
+
+    # Overlap: transfer-bound stream, pipelined EVENT engine, one chip.
+    ov = measure_stream_overlap(devs, n=1 << 22, blobs=8)
+
+    # Roofline accounting.
+    mean_iters = float(np.mean(full.image)) if full.image is not None else max_iter / 4
+    gflops = full.mpixels_per_sec * 1e6 * mean_iters * FLOP_PER_MANDEL_ITER / 1e9
+    hbm_gbps = hbm_stream(devs[0].jax_device)
 
     result = {
         "metric": "mandelbrot_throughput",
         "value": round(full.mpixels_per_sec, 3),
         "unit": "Mpixels/sec",
         "vs_baseline": round(full.mpixels_per_sec / max(base.mpixels_per_sec, 1e-9), 3),
+        "vs_tuned_loop": round(full.mpixels_per_sec / max(tuned_mpix, 1e-9), 3),
+        "tuned_loop_mpix": round(tuned_mpix, 3),
+        "overlap_fraction": round(ov["overlap_fraction"], 4),
+        "overlap_detail_ms": {
+            k: round(ov[k], 3)
+            for k in ("t_read_ms", "t_compute_ms", "t_write_ms", "t_pipelined_ms")
+        },
+        "mean_escape_iters": round(mean_iters, 2),
+        "gflops": round(gflops, 1),
+        "hbm_stream_gbps": round(hbm_gbps, 1),
+        "hbm_utilization": round(hbm_gbps / V5E_HBM_GBPS, 3),
+        "convergence_iters": full.convergence_iters,
+        "note": (
+            "vs_tuned_loop ~1.0 = no framework overhead over a hand-written "
+            "Pallas loop; mandelbrot is VPU-bound (not MXU), so gflops is "
+            "reported against no matmul peak; hbm_utilization is the "
+            "device-resident stream-add fraction of the 819 GB/s v5e roofline"
+        ),
     }
     print(json.dumps(result))
 

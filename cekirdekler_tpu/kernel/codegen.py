@@ -52,6 +52,7 @@ from .lang import (
     CrementStmt,
     Decl,
     For,
+    DoWhile,
     If,
     Index,
     KernelDef,
@@ -586,6 +587,11 @@ def _exec(ctx: _Ctx, node) -> None:
     if isinstance(node, (For, While)):
         _exec_loop(ctx, node)
         return
+    if isinstance(node, DoWhile):
+        # body once unconditionally (under the active mask), then the loop
+        _exec_block(ctx, node.body)
+        _exec_loop(ctx, While(cond=node.cond, body=node.body, line=node.line))
+        return
     if isinstance(node, Return):
         m = ctx.active_mask()
         if m is None:
@@ -775,7 +781,7 @@ def _assigned_vars(stmts: list) -> set[str]:
                 walk(s.step)
             for x in s.body:
                 walk(x)
-        elif isinstance(s, While):
+        elif isinstance(s, (While, DoWhile)):
             for x in s.body:
                 walk(x)
 
@@ -800,7 +806,7 @@ def _stored_bufs(stmts: list) -> set[str]:
                 walk(s.step)
             for x in s.body:
                 walk(x)
-        elif isinstance(s, While):
+        elif isinstance(s, (While, DoWhile)):
             for x in s.body:
                 walk(x)
 

@@ -222,6 +222,15 @@ class While(Node):
 
 
 @dataclass
+class DoWhile(Node):
+    """``do { body } while (cond);`` — body runs once unconditionally,
+    then loops while cond holds (lowered as body + While)."""
+
+    cond: Any
+    body: list
+
+
+@dataclass
 class Return(Node):
     pass
 
@@ -415,7 +424,7 @@ class _Parser:
             if t.text == "while":
                 return self.parse_while()
             if t.text == "do":
-                raise KernelLanguageError("do/while is not supported; use while", line=t.line)
+                return self.parse_do()
             if t.text == "return":
                 self.advance()
                 if not self.accept(";"):
@@ -521,6 +530,16 @@ class _Parser:
         self.expect(")")
         body = self._stmt_as_block()
         return While(cond=cond, body=body, line=line)
+
+    def parse_do(self) -> DoWhile:
+        line = self.expect("do").line
+        body = self._stmt_as_block()
+        self.expect("while")
+        self.expect("(")
+        cond = self.parse_expr()
+        self.expect(")")
+        self.expect(";")
+        return DoWhile(cond=cond, body=body, line=line)
 
     # -- expressions (precedence climbing) ----------------------------------
     def parse_expr(self):

@@ -120,6 +120,11 @@ def mandelbrot_pallas_kernel(interpret: bool | None = None):
             chunk, x0, y0, dx, dy, width, maxIter, offset=gid[0],
             interpret=interpret,
         )
+        if out.shape[0] == chunk:
+            # whole-buffer launch (single chip, no blobbing): the result IS
+            # the buffer — skip the read-modify-write update pass (~16% of
+            # the headline iteration on v5e)
+            return piece
         return jax.lax.dynamic_update_slice(out, piece, (gid[0],))
 
     return mandelbrot
@@ -368,6 +373,117 @@ def run_stream(
         cr.dispose()
         for arr in (a, b, c):
             arr.dispose()
+
+
+def measure_stream_overlap(
+    devices: Devices | None = None,
+    n: int = 1 << 22,
+    blobs: int = 8,
+    local_range: int = 256,
+    pipeline_type: int | None = None,
+    reps: int = 3,
+) -> dict:
+    """Measure the realized read/compute/write overlap fraction of the
+    pipelined path on ONE chip (BASELINE.md metric 2; the engineered
+    property behind the reference's 3× pipelining claim, Cores.cs:467).
+
+    Method: run the SAME blob-chunked work three ways — each phase isolated
+    with a hard fence (read-only, compute-only with data resident,
+    write-only) — then the full pipelined call, all best-of-``reps``.
+    With phase times r, c, w and pipelined total p the realized overlap is::
+
+        overlap = (r + c + w - p) / (r + c + w - max(r, c, w))
+
+    1.0 means the total equals the slowest phase (perfect overlap);
+    0.0 means fully serial.  Negative values (pipeline overhead exceeding
+    any overlap) clip to 0.
+    """
+    from .core.cores import PIPELINE_EVENT
+    from .hardware import all_devices
+
+    if pipeline_type is None:
+        pipeline_type = PIPELINE_EVENT
+    devs = (devices or all_devices()).subset(1)
+    cr = NumberCruncher(devs, STREAM_SRC)
+    w = cr.cores.workers[0]
+    a = ClArray(n, np.float32, name="ov_a", partial_read=True, read_only=True)
+    b = ClArray(n, np.float32, name="ov_b", partial_read=True, read_only=True)
+    c = ClArray(n, np.float32, name="ov_c", write_only=True)
+    a.host()[:] = np.arange(n, dtype=np.float32) % 97
+    b.host()[:] = np.arange(n, dtype=np.float32) % 89
+    blob = n // blobs
+
+    def fence():
+        cr.barrier()
+
+    def phase_read() -> None:
+        for arr in (a, b):
+            w.invalidate(arr)
+        for k in range(blobs):
+            for arr in (a, b):
+                w.upload(arr, k * blob, blob, False)
+        fence()
+
+    def phase_compute() -> None:
+        # data already resident from the last read phase
+        w.ensure_resident(c)
+        for k in range(blobs):
+            w.launch(
+                cr.program, ["streamAdd"], [a, b, c], (),
+                k * blob, blob, local_range, n, local_range,
+            )
+        fence()
+
+    def phase_write() -> None:
+        handles = [
+            w.download_async(c, k * blob, blob, False) for k in range(blobs)
+        ]
+        for h in handles:
+            from .core.worker import Worker
+
+            Worker.finish_download(h)
+
+    def phase_pipelined() -> None:
+        for arr in (a, b, c):
+            w.invalidate(arr)
+        a.next_param(b, c).compute(
+            cr, 7004, "streamAdd", n, local_range,
+            pipeline=True, pipeline_blobs=blobs, pipeline_type=pipeline_type,
+        )
+
+    def best(fn) -> float:
+        ts = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            fn()
+            ts.append((time.perf_counter() - t0) * 1000.0)
+        return min(ts)
+
+    try:
+        phase_read()  # warmup: compile + first-touch
+        phase_compute()
+        phase_write()
+        phase_pipelined()
+        t_r = best(phase_read)
+        t_c = best(phase_compute)
+        t_w = best(phase_write)
+        t_p = best(phase_pipelined)
+        serial = t_r + t_c + t_w
+        ideal = serial - max(t_r, t_c, t_w)
+        overlap = (serial - t_p) / ideal if ideal > 1e-9 else 0.0
+        np.testing.assert_allclose(c.host(), a.host() + b.host())
+        return {
+            "t_read_ms": t_r,
+            "t_compute_ms": t_c,
+            "t_write_ms": t_w,
+            "t_pipelined_ms": t_p,
+            "t_serial_ms": serial,
+            "overlap_fraction": max(0.0, min(1.0, overlap)),
+            "n": n,
+            "blobs": blobs,
+        }
+    finally:
+        cr.dispose()
 
 
 def convergence_iterations(

@@ -137,3 +137,56 @@ def test_partial_range_readback_preserves_host_outside_range():
         assert np.all(out.host()[256:] == -7.0)
     finally:
         cr.dispose()
+
+
+def test_measure_stream_overlap_shape():
+    """Overlap instrumentation runs end-to-end and returns a sane record;
+    the >=0.9 target is asserted on real TPU hardware only (bench.py) —
+    on the CPU rig 'transfers' are memcpys and overlap is meaningless."""
+    from cekirdekler_tpu.workloads import measure_stream_overlap
+
+    ov = measure_stream_overlap(_cpus(), n=1 << 14, blobs=4, reps=1)
+    assert set(ov) >= {
+        "t_read_ms", "t_compute_ms", "t_write_ms", "t_pipelined_ms",
+        "t_serial_ms", "overlap_fraction",
+    }
+    assert 0.0 <= ov["overlap_fraction"] <= 1.0
+    assert ov["t_serial_ms"] >= max(
+        ov["t_read_ms"], ov["t_compute_ms"], ov["t_write_ms"]
+    )
+
+
+def test_pipelined_not_catastrophically_slower_than_plain():
+    """Correctness + sanity wall-clock on the CPU rig: the pipelined path
+    must stay within 3x of the plain path (the strict 'pipelined beats
+    plain' claim is a device-DMA property, asserted on TPU in bench.py's
+    overlap_fraction)."""
+    import time as _t
+
+    from cekirdekler_tpu.arrays.clarray import ClArray
+    from cekirdekler_tpu.core.cruncher import NumberCruncher
+    from cekirdekler_tpu.workloads import STREAM_SRC
+
+    n = 1 << 16
+    cr = NumberCruncher(_cpus().subset(1), STREAM_SRC)
+    try:
+        def run(pipe):
+            a = ClArray(np.arange(n, dtype=np.float32), partial_read=True, read_only=True)
+            b = ClArray(np.ones(n, np.float32), partial_read=True, read_only=True)
+            c = ClArray(n, np.float32, write_only=True)
+            g = a.next_param(b, c)
+            g.compute(cr, 9100 + int(pipe), "streamAdd", n, 64,
+                      pipeline=pipe, pipeline_blobs=8)
+            t0 = _t.perf_counter()
+            for _ in range(3):
+                g.compute(cr, 9100 + int(pipe), "streamAdd", n, 64,
+                          pipeline=pipe, pipeline_blobs=8)
+            dt = _t.perf_counter() - t0
+            np.testing.assert_allclose(np.asarray(c), np.arange(n) + 1)
+            return dt
+
+        t_plain = run(False)
+        t_pipe = run(True)
+        assert t_pipe < 3.0 * t_plain + 0.05, (t_pipe, t_plain)
+    finally:
+        cr.dispose()
