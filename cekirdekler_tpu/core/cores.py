@@ -539,24 +539,27 @@ class Cores:
         reading results back (enqueue-mode sync point; the reference's
         finish() on the used queues, Worker.cs:364-423).
 
-        Materializes one element per buffer: on tunneled backends (axon)
-        ``block_until_ready`` can return before remote execution finishes,
-        so a 4-byte D2H is the reliable fence.
+        Each chip is fenced by ONE fused probe (one tiny dispatch + one
+        4-byte D2H covering every cached buffer — see Worker.fence), and
+        the chips are fenced concurrently: total cost is one round trip,
+        not O(buffers × workers).  On tunneled backends a single RTT is
+        ~100 ms, so this is the difference between a usable and an unusable
+        sync point.
 
         A device/kernel failure surfacing at the fence is REAL — it is
-        collected per buffer and the first one re-raised after all workers
+        collected per worker and the first one re-raised after all workers
         have been fenced (a swallowed error here would let a failed
         dispatch masquerade as a fast, wrong benchmark)."""
-        import numpy as _np
-
+        if len(self.workers) == 1:
+            self.workers[0].fence()
+            return
         errs: list[Exception] = []
-        for w in self.workers:
-            for buf in w._buffers.values():
-                try:
-                    buf.block_until_ready()
-                    _np.asarray(buf[:1])
-                except Exception as e:
-                    errs.append(e)
+        futs = [self.pool.submit(w.fence) for w in self.workers]
+        for f in futs:
+            try:
+                f.result()
+            except Exception as e:
+                errs.append(e)
         if errs:
             raise errs[0]
 

@@ -42,6 +42,24 @@ def _slice_out(buf, off, size: int):
     return lax.dynamic_slice(buf, (jnp.asarray(off, jnp.int32),), (size,))
 
 
+def _fence_probe(bufs):
+    """Fold one element of every buffer into a single f32 scalar: reading
+    it back is ONE 4-byte D2H that cannot complete until every dispatched
+    op writing any of the buffers has retired — a whole-lane fence costing
+    one round trip regardless of how many buffers are cached.
+
+    Built from EAGER per-buffer ops, not one jit over the buffer tuple: a
+    combined jit would retrace+recompile inside the sync point every time
+    the cache's composition changes (new array, resize).  Per-buffer slice
+    ops compile once per distinct (shape, dtype) and are shared across
+    cache compositions; the scalar adds compile once ever."""
+    acc = None
+    for b in bufs:
+        probe = b[:1].astype(jnp.float32)
+        acc = probe if acc is None else acc + probe
+    return acc
+
+
 @jax.jit
 def _update_slice(buf, sl, off):
     return lax.dynamic_update_slice(buf, sl, (jnp.asarray(off, jnp.int32),))
@@ -276,6 +294,18 @@ class Worker:
         host[off : off + data.size] = data
         if markers is not None:
             markers.reach()
+
+    def fence(self) -> None:
+        """Block until every dispatched op on this chip has retired,
+        WITHOUT reading results back (the reference's finish() on the used
+        queues, Worker.cs:364-423).  One probe dispatch + one 4-byte D2H —
+        O(1) round trips per chip, not O(buffers).  On tunneled backends
+        ``block_until_ready`` can return before remote execution finishes,
+        so the host-materialized probe is the reliable fence."""
+        bufs = [b for b in self._buffers.values() if b.size]
+        if not bufs:
+            return
+        np.asarray(_fence_probe(bufs))
 
     def dispose(self) -> None:
         self._buffers.clear()
