@@ -1,31 +1,42 @@
 #!/usr/bin/env python
 """Headline benchmark: mandelbrot throughput (Mpixels/sec) across all
 available chips with iterative load balancing — BASELINE.md's primary
-metric — plus the honest-accounting metrics VERDICT r1 asked for.
+metric — plus the honest-accounting metrics VERDICT r1 #3/#5 and r2 #2-#5
+asked for.
 
 Prints exactly one JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...extras}
 
-Accounting (VERDICT r1 #3/#5):
+Accounting:
 - ``vs_baseline``: framework vs the naive unscheduled loop (one chip, full
   D2H + host sync per iteration) — the r1-continuity number; it mostly
   measures what the enqueue/overlap machinery removes.
 - ``vs_tuned_loop``: framework vs a HAND-WRITTEN jit'd Pallas loop with the
   SAME readback policy (image resident in HBM, fence every 16 iters).
-  This is the claim that matters: ~1.0 means the framework's scheduling
-  adds no overhead over the best raw-JAX loop a user could write.
-- ``overlap_fraction``: measured read/compute/write overlap of the
-  pipelined path on a transfer-bound stream (BASELINE.md target >= 0.9),
-  from isolated-phase timing vs the pipelined total.
-- ``gflops`` + roofline note: mandelbrot is VPU (elementwise) work —
-  FLOPs = pixels x mean escape iterations x ~10 flop/iter; it cannot be
-  judged against the MXU matmul peak.
-- ``hbm_stream_gbps`` / ``hbm_utilization``: device-resident c = a + b
-  (jit, donated, 12 bytes moved/elem) against the v5e HBM roofline
-  (~819 GB/s) — the memory-bound ceiling the chip actually has.
+  ~1.0 means the framework's scheduling adds no overhead over the best
+  raw-JAX loop a user could write (VERDICT r2 #2 target: >= 0.9).
+- ``codegen_mpix`` / ``codegen_vs_pallas``: the SAME workload through the
+  kernel-language path (MANDELBROT_SRC lowered by kernel/codegen.py) — the
+  product's core claim measured, not just its hand-tuned ceiling (r2 #5).
+- ``timeline``: device-side evidence (utils/timeline.py, Xprof trace):
+  per-iteration device busy time and the busy fraction of the enqueue
+  window's makespan.  This replaces round-2's clipped host-stopwatch
+  ``overlap_fraction`` as the primary overlap evidence (r2 #3a); the
+  stream-overlap host measurement is still reported RAW (never clipped)
+  with its fence cost subtracted and shown.
+- ``hbm_stream_gbps`` / ``hbm_utilization``: K dependent DISPATCHES of a
+  donated c = a + b on 256 MiB arrays (working set >> VMEM; separate
+  executions cannot fuse, so every pass genuinely streams HBM) against the
+  v5e roofline (r2 #3b: utilization must be physical, <= 1.0).
+- ``balancer_rig``: the load balancer demonstrated on the 8-device virtual
+  CPU rig with mandelbrot's natural spatial skew — range trajectory +
+  convergence iterations on >= 2 devices (r2 #4; single-chip
+  ``convergence_iters`` is vacuous and says so).
 """
 
 import json
+import os
+import subprocess
 import sys
 import time
 
@@ -74,39 +85,107 @@ def tuned_pallas_loop(dev, width, height, max_iter, iters, warmup, sync_every=16
 
 
 def hbm_stream(dev):
-    """Device-resident stream add: HBM-bandwidth roofline utilization.
-    K sequential passes inside one jit amortize the host-fence latency
-    (a per-rep fence on a tunneled backend measures RTT, not bandwidth)."""
+    """HBM-bandwidth roofline utilization from K DEPENDENT DISPATCHES of a
+    donated ``add`` on 256 MiB arrays.
+
+    Why this shape (VERDICT r2 #3b): anything inside one jit — a fori_loop
+    chain, an unrolled add chain — is fair game for XLA to fuse into a
+    single kernel whose intermediates never touch HBM, which is how round 2
+    printed 2.55x the physical roofline.  Separate executable RUNS cannot
+    fuse: every pass must read both operands from HBM and write its result
+    back (the donation only recycles the allocation).  256 MiB/array is ~2x
+    v5e VMEM, so no pass can run VMEM-resident either."""
     import jax
     import jax.numpy as jnp
-    from jax import lax
 
-    n = 1 << 24  # 64 MiB/array: well past VMEM, HBM-bound
+    n = 1 << 26  # 256 MiB/array
     K = 32
-    a = jax.device_put(jnp.arange(n, dtype=jnp.float32), dev)
-    b = jax.device_put(jnp.full((n,), 1e-9, jnp.float32), dev)
 
     @jax.jit
-    def chain(a, b):
-        # each iteration reads y and b and writes y: 12 bytes/elem/pass
-        return lax.fori_loop(0, K, lambda i, y: y + b, a)
+    def make():
+        return jnp.arange(n, dtype=jnp.float32), jnp.full((n,), 1e-9, jnp.float32)
 
-    out = chain(a, b)
-    _fence(out)
-    # tunnel round-trip baseline: fencing an already-ready value costs one
-    # RTT with zero device work; subtract it so the quotient is bandwidth,
-    # not latency
-    rtt = float("inf")
-    for _ in range(3):
-        t0 = time.perf_counter()
-        _fence(out)
-        rtt = min(rtt, time.perf_counter() - t0)
-    reps, best = 3, float("inf")
-    for _ in range(reps):
-        t0 = time.perf_counter()
-        _fence(chain(a, b))
-        best = min(best, time.perf_counter() - t0)
-    return (K * 3 * 4 * n) / max(best - rtt, 1e-9) / 1e9
+    # default_device pins BOTH jits to the measured chip (the arrays are
+    # created device-side — no tunnel upload — and must not silently land
+    # on whatever the default backend is)
+    with jax.default_device(dev):
+        a, b = make()
+        add = jax.jit(lambda x, y: x + y, donate_argnums=(0,))
+        y = add(a, b)  # compile + warm (consumes a, never used again)
+        _fence(y)
+        rtt = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            _fence(y)
+            rtt = min(rtt, time.perf_counter() - t0)
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for _ in range(K):
+                y = add(y, b)
+            _fence(y)
+            best = min(best, time.perf_counter() - t0 - rtt)
+    return (K * 3 * 4 * n) / max(best, 1e-9) / 1e9
+
+
+def timeline_evidence(devs, width, height, max_iter, iters=8):
+    """Device-timeline metrics for the framework's enqueue window: run
+    ``iters`` framework iterations under an Xprof trace and reduce the
+    device-side op events (utils/timeline.py).  Returns busy-ms/iter,
+    busy fraction of the traced makespan, and the device-derived
+    throughput — evidence from the chip, not host stopwatches."""
+    from cekirdekler_tpu.utils import timeline
+    from cekirdekler_tpu.workloads import run_mandelbrot
+
+    n = width * height
+    trace_dir = "/tmp/ck_bench_trace"
+    with timeline.capture(trace_dir) as result:
+        run_mandelbrot(
+            devs, width=width, height=height, max_iter=max_iter,
+            iters=iters, warmup=0, use_pallas=True, readback="final",
+            sync_every=iters,
+        )
+    tl = result()
+    if tl.n_events == 0:
+        return {"available": False}
+    busy_per_iter = tl.compute_busy_ms / iters
+    return {
+        "available": True,
+        "device_busy_ms_per_iter": round(busy_per_iter, 3),
+        "compute_busy_fraction": round(tl.compute_busy_fraction, 4),
+        "device_mpix": round(n / (busy_per_iter / 1000.0) / 1e6, 1),
+        "n_events": tl.n_events,
+    }
+
+
+def balancer_rig_section():
+    """Run the balancer demonstration on the 8-device virtual CPU rig in a
+    clean subprocess (the accelerator plugin pins platform selection in
+    this process, same re-exec strategy as tests/conftest.py)."""
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = [
+        f for f in env.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in f
+    ]
+    flags.append("--xla_force_host_platform_device_count=8")
+    env["XLA_FLAGS"] = " ".join(flags)
+    here = os.path.dirname(os.path.abspath(__file__))
+    proc = None
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-m", "cekirdekler_tpu.benchrig"],
+            env=env, cwd=here, timeout=900, capture_output=True, text=True,
+        )
+        return json.loads(proc.stdout.strip().splitlines()[-1])
+    except Exception as e:
+        err = {"ok": False, "error": f"{type(e).__name__}: {e}"}
+        if proc is not None:
+            # surface the subprocess's own failure, not just the decode error
+            err["returncode"] = proc.returncode
+            err["stderr_tail"] = proc.stderr[-2000:]
+        return err
 
 
 def main() -> None:
@@ -144,13 +223,28 @@ def main() -> None:
         keep_image=True,
     )
 
-    # Overlap: transfer-bound stream, pipelined EVENT engine, one chip.
+    # Kernel-language path: the SAME workload through MANDELBROT_SRC and
+    # kernel/codegen.py's vectorized lowering (the driver-JIT replacement
+    # that is the product's core claim) — same readback policy.
+    cg = run_mandelbrot(
+        devs.subset(1), width=width, height=height, max_iter=max_iter,
+        iters=8, warmup=2, use_pallas=False, readback="final", sync_every=8,
+    )
+
+    # Device-timeline evidence for the enqueue window (r2 #3a).
+    tl = timeline_evidence(devs.subset(1), width, height, max_iter)
+
+    # Host-window stream overlap, RAW ratio + fence cost shown (r2 #3a).
     ov = measure_stream_overlap(devs, n=1 << 22, blobs=8)
 
     # Roofline accounting.
     mean_iters = float(np.mean(full.image)) if full.image is not None else max_iter / 4
     gflops = full.mpixels_per_sec * 1e6 * mean_iters * FLOP_PER_MANDEL_ITER / 1e9
     hbm_gbps = hbm_stream(devs[0].jax_device)
+    hbm_util = hbm_gbps / V5E_HBM_GBPS
+
+    # Balancer on the 8-device rig with skewed per-range load (r2 #4).
+    rig = balancer_rig_section()
 
     result = {
         "metric": "mandelbrot_throughput",
@@ -159,21 +253,36 @@ def main() -> None:
         "vs_baseline": round(full.mpixels_per_sec / max(base.mpixels_per_sec, 1e-9), 3),
         "vs_tuned_loop": round(full.mpixels_per_sec / max(tuned_mpix, 1e-9), 3),
         "tuned_loop_mpix": round(tuned_mpix, 3),
-        "overlap_fraction": round(ov["overlap_fraction"], 4),
+        "codegen_mpix": round(cg.mpixels_per_sec, 3),
+        "codegen_vs_pallas": round(
+            cg.mpixels_per_sec / max(full.mpixels_per_sec, 1e-9), 3
+        ),
+        "timeline": tl,
+        "overlap_fraction_raw": round(ov["overlap_fraction"], 4),
         "overlap_detail_ms": {
             k: round(ov[k], 3)
-            for k in ("t_read_ms", "t_compute_ms", "t_write_ms", "t_pipelined_ms")
+            for k in (
+                "t_read_ms", "t_compute_ms", "t_write_ms", "t_pipelined_ms",
+                "rtt_ms",
+            )
         },
         "mean_escape_iters": round(mean_iters, 2),
         "gflops": round(gflops, 1),
         "hbm_stream_gbps": round(hbm_gbps, 1),
-        "hbm_utilization": round(hbm_gbps / V5E_HBM_GBPS, 3),
-        "convergence_iters": full.convergence_iters,
+        "hbm_utilization": round(hbm_util, 3),
+        "hbm_measurement_suspect": bool(hbm_util > 1.0),
+        "convergence_iters_1chip_note": "vacuous on 1 chip; see balancer_rig",
+        "balancer_rig": rig,
         "note": (
             "vs_tuned_loop ~1.0 = no framework overhead over a hand-written "
-            "Pallas loop; mandelbrot is VPU-bound (not MXU), so gflops is "
-            "reported against no matmul peak; hbm_utilization is the "
-            "device-resident stream-add fraction of the 819 GB/s v5e roofline"
+            "Pallas loop; codegen_vs_pallas compares the C-subset "
+            "kernel-language lowering (orbit state streams HBM every escape "
+            "iteration) against the VMEM-resident Pallas kernel; timeline.* "
+            "comes from device-side Xprof op events (this backend exposes no "
+            "DMA events, so transfer overlap uses the RTT-subtracted host "
+            "windows in overlap_detail_ms, reported raw, never clipped); "
+            "mandelbrot is VPU-bound (not MXU); hbm_utilization is "
+            "cross-dispatch streamed and must be <= 1.0 to be physical"
         ),
     }
     print(json.dumps(result))

@@ -94,6 +94,16 @@ class Cores:
         self._enqueued: list[tuple[Worker, ClArray, int, int, bool]] = []
         self._lock = threading.Lock()
         self.last_compute_id: int | None = None
+        # enqueue-mode rebalance state: compute ids dispatched since the
+        # last barrier (+ the dispatch-window start time) and the ids whose
+        # benches the barrier refreshed — those MAY rebalance on their next
+        # call even in enqueue mode (the reference pins enqueue-mode work to
+        # one device, Cores.cs:836-949; we rebalance at sync points instead,
+        # the moral equivalent of feeding event benches into loadBalance,
+        # HelperFunctions.cs:190-280)
+        self._enqueue_cids: set[int] = set()
+        self._enqueue_t0: float | None = None
+        self._enqueue_rebalance: set[int] = set()
 
     @property
     def num_devices(self) -> int:
@@ -176,16 +186,40 @@ class Cores:
                 f"global_range ({global_range}) must be divisible by step ({step})"
             )
         t_start = time.perf_counter()
-        # enqueue mode pins the ranges: (a) read-resident data would go
-        # stale if shares moved between chips; (b) without per-call host
-        # sync the benchmarks only measure async dispatch time, so
-        # rebalancing on them is noise; (c) a chip whose share dropped to
-        # zero would leave a stale deferred-download record for flush().
-        # (The reference supports enqueue mode single-device only,
-        # Cores.cs:836-949.)
+        # Enqueue mode cannot rebalance on per-call host benches (they only
+        # measure async dispatch time), so ranges hold still BETWEEN syncs
+        # and move AT them: barrier() times each chip's retirement fence and
+        # feeds that into the balancer, arming a one-shot rebalance for the
+        # next call (the reference supports enqueue mode single-device only,
+        # Cores.cs:836-949; its multi-device path rebalances per call on
+        # event benches — ours does at sync granularity).  Residency stays
+        # correct across a move because workers skip re-uploads only for
+        # covered ranges (Worker.upload_covers).
+        if self.enqueue_mode:
+            if self._enqueue_t0 is None:
+                self._enqueue_t0 = t_start
+            self._enqueue_cids.add(compute_id)
+        old_ranges = list(self.global_ranges.get(compute_id, ()))
         ranges, refs = self._ranges_for(
-            compute_id, global_range, step, rebalance=not self.enqueue_mode
+            compute_id,
+            global_range,
+            step,
+            rebalance=(not self.enqueue_mode)
+            or compute_id in self._enqueue_rebalance,
         )
+        self._enqueue_rebalance.discard(compute_id)
+        if self.enqueue_mode and old_ranges and ranges != old_ranges:
+            # the balancer moved shares between syncs: host arrays must be
+            # made current BEFORE any chip uploads its newly-acquired region
+            # (the freshest data for that region is on the previous owner's
+            # HBM; its deferred download record is in the pending list) —
+            # and every chip's upload-coverage record is reset, else a chip
+            # RE-acquiring a range it held before an earlier move would
+            # pass upload_covers() on stale coverage and skip the fetch of
+            # data another chip updated in between
+            self.flush()
+            for w in self.workers:
+                w.reset_coverage()
         # a chip whose share was quantized to zero never re-runs its bench;
         # decay its stale measurement so a one-off slow call (e.g. first-call
         # compile) cannot starve it permanently
@@ -282,10 +316,12 @@ class Cores:
             for idx, p in enumerate(params):
                 fl = p.flags
                 if fl.read and not fl.write_only:
-                    if self.enqueue_mode and id(p) in w._buffers:
-                        continue  # data lives in HBM across enqueued computes
                     epw = fl.elements_per_work_item
                     full = single or not fl.partial_read
+                    if self.enqueue_mode and w.upload_covers(
+                        p, 0 if full else offset * epw, p.size if full else size * epw
+                    ):
+                        continue  # data lives in HBM across enqueued computes
                     w.upload(p, offset * epw, size * epw, full)
                 else:
                     w.ensure_resident(p)
@@ -328,12 +364,26 @@ class Cores:
         finally:
             w.end_bench(compute_id)
 
-    def _pipeline_prologue(self, w: Worker, params: Sequence[ClArray]):
+    def _pipeline_prologue(
+        self, w: Worker, params: Sequence[ClArray], offset: int, size: int
+    ):
         """Shared per-call setup for both pipeline engines: residency
         snapshot + up-front upload of non-blobbed arrays."""
         # enqueue mode: snapshot residency BEFORE any uploads — a buffer
-        # created by blob 1 must not suppress blobs 2..N of the same call
-        resident = {id(p) for p in params if id(p) in w._buffers} if self.enqueue_mode else set()
+        # created by blob 1 must not suppress blobs 2..N of the same call.
+        # Coverage is range-aware: a partial array whose chip range MOVED at
+        # the last sync-point rebalance is not "resident" and re-uploads.
+        resident = set()
+        if self.enqueue_mode:
+            for p in params:
+                epw = p.flags.elements_per_work_item
+                covered = (
+                    w.upload_covers(p, offset * epw, size * epw)
+                    if p.flags.partial_read
+                    else w.upload_covers(p, 0, p.size)
+                )
+                if covered:
+                    resident.add(id(p))
         # non-blobbed arrays (not partial) upload once up-front
         for p in params:
             fl = p.flags
@@ -398,7 +448,7 @@ class Cores:
         blob = size // blobs
         if blob <= 0:
             blob, blobs = size, 1
-        resident = self._pipeline_prologue(w, params)
+        resident = self._pipeline_prologue(w, params, offset, size)
         handles = []
         for k in range(blobs):
             boff = offset + k * blob
@@ -452,7 +502,7 @@ class Cores:
         blob = size // blobs
         if blob <= 0:
             blob, blobs = size, 1
-        resident = self._pipeline_prologue(w, params)
+        resident = self._pipeline_prologue(w, params, offset, size)
         partials = [
             p
             for p in params
@@ -503,15 +553,18 @@ class Cores:
         """Read back and join everything deferred by enqueue mode."""
         with self._lock:
             pending, self._enqueued = self._enqueued, []
-        seen: set[tuple[int, int]] = set()
-        handles = []
         # keep the most recent record per (worker, array) — it reflects the
         # latest device contents
-        for w, p, offset, size, write_all in reversed(pending):
-            key = (id(w), id(p))
-            if key in seen:
-                continue
-            seen.add(key)
+        latest: dict[tuple[int, int], int] = {}
+        for i, (w, p, _, _, _) in enumerate(pending):
+            latest[(id(w), id(p))] = i
+        # host writes land in CHRONOLOGICAL order: after a sync-point
+        # rebalance two workers' latest slices of one array can overlap
+        # (the grown chip recomputed a region the shrunk chip wrote
+        # earlier) — the newer record must be the one that sticks
+        handles = []
+        for i in sorted(latest.values()):
+            w, p, offset, size, write_all = pending[i]
             epw = p.flags.elements_per_work_item
             if write_all:
                 handles.append(w.download_async(p, 0, p.size, True))
@@ -549,19 +602,51 @@ class Cores:
         A device/kernel failure surfacing at the fence is REAL — it is
         collected per worker and the first one re-raised after all workers
         have been fenced (a swallowed error here would let a failed
-        dispatch masquerade as a fast, wrong benchmark)."""
-        if len(self.workers) == 1:
-            self.workers[0].fence()
-            return
-        errs: list[Exception] = []
-        futs = [self.pool.submit(w.fence) for w in self.workers]
-        for f in futs:
-            try:
-                f.result()
-            except Exception as e:
-                errs.append(e)
-        if errs:
-            raise errs[0]
+        dispatch masquerade as a fast, wrong benchmark).
+
+        Enqueue-mode balancing happens HERE: each chip's fence-retire time
+        since the dispatch window opened is the chip's measured backlog —
+        that is fed into its benchmark for every compute id dispatched since
+        the last barrier, and those ids are armed to rebalance on their next
+        call (sync-granularity analogue of the reference feeding event
+        benches into loadBalance, HelperFunctions.cs:190-280)."""
+        t0 = self._enqueue_t0
+        measure = self.enqueue_mode and t0 is not None and len(self.workers) > 1
+        try:
+            if len(self.workers) == 1:
+                self.workers[0].fence()
+                return
+            done_at: dict[int, float] = {}
+
+            def fence_timed(w: Worker) -> None:
+                w.fence()
+                done_at[w.index] = time.perf_counter()
+
+            errs: list[Exception] = []
+            futs = [self.pool.submit(fence_timed, w) for w in self.workers]
+            for f in futs:
+                try:
+                    f.result()
+                except Exception as e:
+                    errs.append(e)
+            if errs:
+                raise errs[0]
+            if measure:
+                for w in self.workers:
+                    bench = (done_at[w.index] - t0) * 1000.0
+                    for cid in self._enqueue_cids:
+                        # only chips that ran this id refresh its bench
+                        if self.global_ranges.get(cid, [1] * len(self.workers))[w.index] > 0:
+                            w.benchmarks[cid] = bench
+                self._enqueue_rebalance |= self._enqueue_cids
+        finally:
+            # always close the window — a fence failure must not leave a
+            # stale t0/cid set to corrupt the NEXT window's benches
+            self._enqueue_window_closed()
+
+    def _enqueue_window_closed(self) -> None:
+        self._enqueue_cids.clear()
+        self._enqueue_t0 = None
 
     def ranges_of(self, compute_id: int) -> list[int]:
         return list(self.global_ranges.get(compute_id, []))

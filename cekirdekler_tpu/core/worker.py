@@ -88,6 +88,11 @@ class Worker:
         # array-object → device buffer (reference: Worker.cs:194)
         self._buffers: dict[int, Any] = {}
         self._buffer_owner: dict[int, ClArray] = {}  # strong refs, like the reference
+        # array-object → (offset, size) element range this chip has uploaded;
+        # enqueue mode skips a re-upload only when the requested range is
+        # covered — so the balancer may MOVE ranges between syncs and the
+        # newly-acquired region is fetched instead of silently served stale
+        self._uploaded: dict[int, tuple[int, int]] = {}
         # per-compute-id accumulated wall ms (reference: Worker.cs:190,753-807)
         self.benchmarks: dict[int, float] = {}
         self._bench_t0: dict[int, float] = {}
@@ -116,6 +121,7 @@ class Worker:
             buf = jax.device_put(jnp.zeros(host.size, host.dtype), self.device)
             self._buffers[key] = buf
             self._buffer_owner[key] = arr
+            self._uploaded.pop(key, None)
         return buf
 
     def _h2d(self, host_slice: np.ndarray, zero_copy: bool):
@@ -140,6 +146,30 @@ class Worker:
         # would land on the default device and force a cross-device copy
         return jax.device_put(host_slice, self.device)
 
+    def upload_covers(self, arr: ClArray, offset_elems: int, size_elems: int) -> bool:
+        """True iff this chip's resident data already covers the requested
+        element range (the enqueue-mode residency test; range-aware so a
+        rebalance between syncs forces a fetch of the moved region)."""
+        rec = self._uploaded.get(id(arr))
+        return (
+            rec is not None
+            and id(arr) in self._buffers
+            and rec[0] <= offset_elems
+            and offset_elems + size_elems <= rec[0] + rec[1]
+        )
+
+    def _record_upload(self, arr: ClArray, offset_elems: int, size_elems: int) -> None:
+        key = id(arr)
+        rec = self._uploaded.get(key)
+        if rec is not None and not (
+            offset_elems > rec[0] + rec[1] or rec[0] > offset_elems + size_elems
+        ):
+            lo = min(rec[0], offset_elems)
+            hi = max(rec[0] + rec[1], offset_elems + size_elems)
+            self._uploaded[key] = (lo, hi - lo)
+        else:
+            self._uploaded[key] = (offset_elems, size_elems)
+
     def upload(self, arr: ClArray, offset_elems: int, size_elems: int, full: bool) -> None:
         """H2D: full array or only this chip's range slice (reference:
         writeToBuffer / writeToBufferRanged, Worker.cs:821-885)."""
@@ -149,6 +179,7 @@ class Worker:
             buf = self._h2d(host, arr.flags.zero_copy)
             self._buffers[key] = buf
             self._buffer_owner[key] = arr
+            self._uploaded[key] = (0, host.size)
             if self.markers is not None:
                 self.markers.add()
                 self.markers.reach_when_ready(buf)
@@ -159,6 +190,7 @@ class Worker:
         sl = self._h2d(host[offset_elems : offset_elems + size_elems], arr.flags.zero_copy)
         out = _update_slice(buf, sl, offset_elems)
         self._buffers[key] = out
+        self._record_upload(arr, offset_elems, size_elems)
         if self.markers is not None:
             self.markers.reach_when_ready(out)
 
@@ -182,6 +214,7 @@ class Worker:
         arr, sl, off = staged
         buf = self._buffer_for(arr)
         self._buffers[id(arr)] = _update_slice(buf, sl, off)
+        self._record_upload(arr, off, sl.shape[0])
 
     def ensure_resident(self, arr: ClArray) -> Any:
         """Buffer for a non-read array: reuse cache or zeros (the kernel is
@@ -198,6 +231,15 @@ class Worker:
     def invalidate(self, arr: ClArray) -> None:
         self._buffers.pop(id(arr), None)
         self._buffer_owner.pop(id(arr), None)
+        self._uploaded.pop(id(arr), None)
+
+    def reset_coverage(self) -> None:
+        """Forget what has been uploaded WITHOUT dropping device buffers:
+        the next enqueue-mode compute re-fetches its range from host.
+        Called when a rebalance moves ranges — coverage records only ever
+        grow, so a chip that lost a region and later re-acquires it would
+        otherwise skip the re-upload and read stale data."""
+        self._uploaded.clear()
 
     # -- launch --------------------------------------------------------------
     def launch(
@@ -310,6 +352,7 @@ class Worker:
     def dispose(self) -> None:
         self._buffers.clear()
         self._buffer_owner.clear()
+        self._uploaded.clear()
         self.benchmarks.clear()
         if self.markers is not None:
             self.markers.close()

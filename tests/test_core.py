@@ -346,3 +346,109 @@ def test_markers_observe_real_retirement(devs):
     assert cr.count_markers_remaining() == 0
     assert cr.count_markers_reached() > 0
     cr.dispose()
+
+
+def test_enqueue_mode_rebalances_at_barrier(devs):
+    """Enqueue mode must NOT pin ranges forever: barrier() measures each
+    chip's fence-retire time and arms a rebalance for the next call
+    (VERDICT r2 #4 — sync-granularity analogue of the reference feeding
+    event benches into loadBalance, HelperFunctions.cs:190-280).  A chip
+    made artificially slow at the fence loses share, and results stay
+    correct after the boundary moves."""
+    cr = NumberCruncher(devs.subset(2), VADD)
+    x = ClArray(np.zeros(4096, np.float32), name="x")
+    x.partial_read = True
+    cr.enqueue_mode = True
+    slow = cr.cores.workers[0]
+    orig_fence = slow.fence
+
+    def laggy_fence():
+        import time as _t
+
+        _t.sleep(0.25)  # pretend this chip retires late
+        orig_fence()
+
+    slow.fence = laggy_fence
+    try:
+        for _ in range(3):
+            x.compute(cr, 9, "inc", 4096, 64)
+        first = cr.ranges_of(9)
+        cr.barrier()  # measures per-chip retirement, arms rebalance
+        for _ in range(3):
+            x.compute(cr, 9, "inc", 4096, 64)
+        second = cr.ranges_of(9)
+    finally:
+        slow.fence = orig_fence
+    assert first[0] == first[1], "first split should be equal"
+    assert second[0] < first[0], "slow chip should lose share after barrier"
+    assert sum(second) == 4096
+    cr.enqueue_mode = False  # flush
+    np.testing.assert_allclose(np.asarray(x), 6.0)
+    cr.dispose()
+
+
+def test_enqueue_rebalance_write_only_image(devs):
+    """Write-only output stays correct across an enqueue-mode range move:
+    the grown chip recomputes its acquired region and flush() lands host
+    writes chronologically (newest record wins)."""
+    src = """
+    __kernel void fillidx(__global float* o) {
+        int i = get_global_id(0);
+        o[i] = (float)i;
+    }"""
+    cr = NumberCruncher(devs.subset(2), src)
+    o = ClArray(4096, np.float32, name="o")
+    o.write_only = True
+    cr.enqueue_mode = True
+    slow = cr.cores.workers[1]
+    orig_fence = slow.fence
+
+    def laggy_fence():
+        import time as _t
+
+        _t.sleep(0.25)
+        orig_fence()
+
+    slow.fence = laggy_fence
+    try:
+        o.compute(cr, 11, "fillidx", 4096, 64)
+        cr.barrier()
+        o.compute(cr, 11, "fillidx", 4096, 64)
+        moved = cr.ranges_of(11)
+    finally:
+        slow.fence = orig_fence
+    assert moved[1] < 2048, "slow chip should have lost share"
+    cr.enqueue_mode = False
+    np.testing.assert_allclose(np.asarray(o), np.arange(4096, dtype=np.float32))
+    cr.dispose()
+
+
+def test_enqueue_rebalance_reacquired_range_not_stale(devs):
+    """A chip that loses a region and later RE-acquires it must re-fetch
+    it (coverage records are reset on every range move): alternate which
+    chip is slow so ranges oscillate across barriers, and verify the
+    read+write array stays exact."""
+    import time as _t
+
+    cr = NumberCruncher(devs.subset(2), VADD)
+    x = ClArray(np.zeros(4096, np.float32), name="x")
+    x.partial_read = True
+    cr.enqueue_mode = True
+    w0, w1 = cr.cores.workers
+    f0, f1 = w0.fence, w1.fence
+    total = 0
+    try:
+        for phase in range(3):
+            slow = w0 if phase % 2 == 0 else w1
+            orig = f0 if phase % 2 == 0 else f1
+            slow.fence = lambda orig=orig: (_t.sleep(0.2), orig())[1]
+            for _ in range(2):
+                x.compute(cr, 13, "inc", 4096, 64)
+                total += 1
+            cr.barrier()
+            w0.fence, w1.fence = f0, f1
+    finally:
+        w0.fence, w1.fence = f0, f1
+    cr.enqueue_mode = False
+    np.testing.assert_allclose(np.asarray(x), float(total))
+    cr.dispose()

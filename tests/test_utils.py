@@ -126,3 +126,55 @@ def test_performance_history_accumulates():
         assert sum(hist[-1].device_items) == n
     finally:
         cr.dispose()
+
+
+def test_timeline_merged_busy_math():
+    from cekirdekler_tpu.utils.timeline import _merged_busy
+
+    # disjoint + overlapping + contained intervals
+    assert _merged_busy([(0.0, 10.0), (20.0, 30.0)]) == 20.0
+    assert _merged_busy([(0.0, 10.0), (5.0, 15.0)]) == 15.0
+    assert _merged_busy([(0.0, 10.0), (2.0, 3.0)]) == 10.0
+    assert _merged_busy([]) == 0.0
+
+
+def test_timeline_capture_graceful_without_device_events(tmp_path):
+    """On the CPU rig the profiler exposes no '/device:' process — the
+    capture must still run the region and return an empty analysis (the
+    tunneled-TPU path is exercised by bench.py's timeline_evidence)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from cekirdekler_tpu.utils import timeline
+
+    with timeline.capture(str(tmp_path / "tr")) as result:
+        x = jnp.arange(1024, dtype=jnp.float32) * 2
+        np.asarray(x)
+    tl = result()
+    assert tl.span_ms >= 0.0
+    assert 0.0 <= tl.compute_busy_fraction <= 1.0 or tl.n_events == 0
+
+
+def test_tracer_report_runs(tmp_path):
+    import jax.numpy as jnp
+    import numpy as np
+
+    from cekirdekler_tpu.utils.timeline import Tracer
+
+    tr = Tracer(str(tmp_path / "traces"))
+    with tr.region("warm"):
+        np.asarray(jnp.ones(64) + 1)
+    assert "warm" in tr.report()
+
+
+def test_timeline_capture_propagates_region_exception(tmp_path):
+    """An exception raised inside the traced region must surface unchanged
+    (regression: the generator used to yield a second time, masking the
+    real error as RuntimeError)."""
+    import pytest
+
+    from cekirdekler_tpu.utils import timeline
+
+    with pytest.raises(ValueError, match="real error"):
+        with timeline.capture(str(tmp_path / "tr")):
+            raise ValueError("real error")

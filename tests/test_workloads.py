@@ -148,9 +148,12 @@ def test_measure_stream_overlap_shape():
     ov = measure_stream_overlap(_cpus(), n=1 << 14, blobs=4, reps=1)
     assert set(ov) >= {
         "t_read_ms", "t_compute_ms", "t_write_ms", "t_pipelined_ms",
-        "t_serial_ms", "overlap_fraction",
+        "t_serial_ms", "overlap_fraction", "rtt_ms",
     }
-    assert 0.0 <= ov["overlap_fraction"] <= 1.0
+    # the ratio is RAW (unclipped, VERDICT r2 #3) — on the CPU rig where
+    # "transfers" are memcpys it can be far outside [0, 1]; only finiteness
+    # and the serial-sum identity are backend-independent
+    assert np.isfinite(ov["overlap_fraction"])
     assert ov["t_serial_ms"] >= max(
         ov["t_read_ms"], ov["t_compute_ms"], ov["t_write_ms"]
     )

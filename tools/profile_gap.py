@@ -1,0 +1,117 @@
+"""Localize the vs_tuned_loop gap: time the framework mandelbrot path
+against the hand-written Pallas loop, then peel the framework's layers one
+at a time (direct launcher-fn loop, compute() with launch skipped) so
+overhead lands on a named component (methodology behind VERDICT r2 #2).
+
+Run on the TPU chip: ``python tools/profile_gap.py``.
+r3 measurements (v5e via tunnel, 2048x2048, 256 max-iter, sync every 16):
+  tuned pallas loop       19.52 ms/iter   214.9 Mpix/s
+  direct launcher fn      18.27 ms/iter   229.6 Mpix/s
+  framework compute()     18.51 ms/iter   226.6 Mpix/s   (vs tuned: 1.05)
+  sched only (no launch)   7.80 ms/iter
+  barrier (idle)          82.3 ms  == raw fence (1 tunnel RTT)
+The round-2 0.641 ratio was the O(buffers) barrier (fixed: single-probe
+fence per chip); scheduling itself adds ~0.25 ms/iter over a raw jit loop.
+"""
+
+import time
+
+import numpy as np
+
+
+def fence(x):
+    np.asarray(x[:1])
+
+
+def main():
+    import jax
+
+    import cekirdekler_tpu as ct
+    from cekirdekler_tpu.arrays.clarray import ClArray
+    from cekirdekler_tpu.core.cruncher import NumberCruncher
+    from cekirdekler_tpu.ops.mandelbrot import mandelbrot_pallas
+    from cekirdekler_tpu.workloads import mandelbrot_pallas_kernel
+
+    devs = ct.all_devices()
+    tpus = devs.tpus()
+    if len(tpus):
+        devs = tpus
+    devs = devs.subset(1)
+    dev = devs[0].jax_device
+    print("device:", dev)
+
+    width = height = 2048
+    n = width * height
+    max_iter = 256
+    iters, warmup, sync_every = 32, 4, 16
+    args = dict(
+        n=n, x0=-2.0, y0=-1.25, dx=2.5 / width, dy=2.5 / height,
+        width=width, max_iter=max_iter,
+        interpret=jax.default_backend() != "tpu",
+    )
+
+    def timed(label, fn_iter, fence_out):
+        out = fn_iter()
+        fence_out(out)
+        times = []
+        for k in range(warmup + iters):
+            t0 = time.perf_counter()
+            out = fn_iter()
+            if (k + 1) % sync_every == 0 or k == warmup + iters - 1:
+                fence_out(out)
+            if k >= warmup:
+                times.append((time.perf_counter() - t0) * 1000.0)
+            elif k == warmup - 1:
+                fence_out(out)
+        mpix = (n * len(times)) / (sum(times) / 1000.0) / 1e6
+        print(f"{label:40s} {sum(times)/len(times):8.3f} ms/iter  {mpix:8.1f} Mpix/s")
+        return mpix
+
+    timed("tuned pallas loop", lambda: mandelbrot_pallas(**args), fence)
+
+    src = mandelbrot_pallas_kernel(interpret=args["interpret"])
+    cr = NumberCruncher(devs, src)
+    vals = (-2.0, -1.25, 2.5 / width, 2.5 / height, width, max_iter)
+    fn, _ = cr.program.launcher("mandelbrot", n, 256, n)
+    import jax.numpy as jnp
+
+    state = {"buf": jax.device_put(jnp.zeros(n, jnp.float32), dev)}
+
+    def launcher_iter():
+        out = fn(0, (state["buf"],), vals)
+        state["buf"] = out[0]
+        return out[0]
+
+    timed("direct launcher fn", launcher_iter, fence)
+
+    out_arr = ClArray(n, np.float32, name="mandel_out", read=False, write=True)
+    cr.enqueue_mode = True
+
+    def fw_iter():
+        out_arr.compute(cr, 7001, "mandelbrot", n, 256, values=vals)
+
+    def fw_fence(_):
+        cr.barrier()
+
+    timed("framework compute() enqueue", fw_iter, fw_fence)
+
+    cr.no_compute_mode = True
+    timed("framework no_compute (sched only)", fw_iter, fw_fence)
+    cr.no_compute_mode = False
+
+    cr.barrier()
+    t0 = time.perf_counter()
+    for _ in range(8):
+        cr.barrier()
+    print(f"{'barrier (idle) x8':40s} {(time.perf_counter()-t0)/8*1000:8.3f} ms/call")
+    t0 = time.perf_counter()
+    for _ in range(8):
+        fence(state["buf"])
+    print(f"{'raw fence (idle) x8':40s} {(time.perf_counter()-t0)/8*1000:8.3f} ms/call")
+
+    cr.enqueue_mode = False
+    cr.dispose()
+
+
+if __name__ == "__main__":
+    main()
