@@ -104,6 +104,11 @@ class Cores:
         self._enqueue_cids: set[int] = set()
         self._enqueue_t0: float | None = None
         self._enqueue_rebalance: set[int] = set()
+        # host-gated dispatch (reference: ClUserEvent bound to queues +
+        # Worker.cs:487-557 synchronized start): when set, every worker
+        # lane blocks on the event before its compute phase, so triggering
+        # starts all lanes simultaneously
+        self.dispatch_gate = None
 
     @property
     def num_devices(self) -> int:
@@ -297,6 +302,9 @@ class Cores:
         value_args,
         write_all_owner: dict[int, int],
     ) -> None:
+        gate = self.dispatch_gate
+        if gate is not None:
+            gate.wait()  # synchronized start across lanes (ClUserEvent)
         w.start_bench(compute_id)
         single = self.num_devices == 1
         try:

@@ -170,6 +170,21 @@ class NumberCruncher:
         reset — a failed cruncher stays dead; we allow explicit recovery)."""
         self.number_of_errors_happened = 0
 
+    # -- host-gated dispatch (reference: ClUserEvent.cs:29-121 +
+    # Worker.cs:487-557 synchronized start) ----------------------------------
+    @property
+    def dispatch_gate(self):
+        """A :class:`~cekirdekler_tpu.utils.events.UserEvent` (or None):
+        while set and untriggered, every worker lane holds at the top of
+        its compute phase; ``trigger()`` starts all lanes simultaneously.
+        Call computes from a separate thread (or use enqueue mode) if the
+        host must trigger after the compute call has been issued."""
+        return self.cores.dispatch_gate
+
+    @dispatch_gate.setter
+    def dispatch_gate(self, gate) -> None:
+        self.cores.dispatch_gate = gate
+
     # -- sync / reporting ----------------------------------------------------
     def flush(self) -> None:
         """Join deferred enqueue-mode work (reference:

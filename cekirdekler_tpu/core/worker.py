@@ -37,6 +37,12 @@ from ..utils.markers import MarkerCounter
 __all__ = ["Worker"]
 
 
+def _native_lib():
+    from ..native import load
+
+    return load()
+
+
 @partial(jax.jit, static_argnums=(2,))
 def _slice_out(buf, off, size: int):
     return lax.dynamic_slice(buf, (jnp.asarray(off, jnp.int32),), (size,))
@@ -272,6 +278,7 @@ class Worker:
             seq_fn = program.sequence_launcher(
                 tuple(names), tuple(_ladder(size, step)), local_range,
                 global_size, repeats, sync_kernel, value_args,
+                platform=self.device.platform,
             )
         if seq_fn is not None:
             bufs = tuple(seq_fn(offset, bufs))
@@ -293,7 +300,10 @@ class Worker:
                     for name in names_seq:
                         va = value_args.get(name, ()) if isinstance(value_args, dict) else tuple(value_args)
                         for chunk in _ladder(size, step):
-                            fn, info = program.launcher(name, chunk, local_range, global_size)
+                            fn, info = program.launcher(
+                                name, chunk, local_range, global_size,
+                                platform=self.device.platform,
+                            )
                             n_arr = program.array_param_count(name)
                             out = fn(offset, bufs[:n_arr], tuple(va))
                             bufs = tuple(out) + bufs[n_arr:]
@@ -333,7 +343,26 @@ class Worker:
         arr, out, off, markers = handle
         host = arr.host()
         data = np.asarray(out)
-        host[off : off + data.size] = data
+        view = host[off : off + data.size]
+        lib = _native_lib()
+        if (
+            lib is not None
+            and data.nbytes >= (4 << 20)
+            and view.size == data.size  # a truncated slice must go through
+            # numpy assignment below so it RAISES like it always did,
+            # never a GIL-free out-of-bounds write
+            and view.flags["C_CONTIGUOUS"]
+            and data.flags["C_CONTIGUOUS"]
+            and view.dtype == data.dtype
+        ):
+            # multi-MB writeback: GIL-free parallel memcpy through the
+            # native copy engine (kutuphane_tpu.cpp ck_copyParallel) —
+            # concurrent worker joins stop serializing on the GIL
+            lib.ck_copyParallel(
+                view.ctypes.data, data.ctypes.data, data.nbytes, 4
+            )
+        else:
+            view[:] = data
         if markers is not None:
             markers.reach()
 

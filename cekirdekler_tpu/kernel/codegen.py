@@ -152,16 +152,24 @@ class KVal:
 
 
 class _Ctx:
-    """Interpretation context for one kernel launch chunk."""
+    """Interpretation context for one kernel launch chunk.
+
+    ``shape`` is the vector shape every work-item-parallel value carries:
+    ``(B,)`` for the XLA lowering, ``(rows, 128)`` for the Pallas tile
+    lowering (pallas_backend.py) — the interpreter itself is shape-agnostic.
+    """
+
+    pallas = False  # the Pallas tile subclass flips this
 
     def __init__(self, B: int, offset, global_size, local_size: int, ctx_info: dict):
         self.B = B
+        self.shape: tuple[int, ...] = (B,)
         self.offset = offset  # scalar int32 (traced)
         self.env: dict[str, KVal] = {}
         self.bufs: dict[str, Any] = {}
         self.buf_ctypes: dict[str, str] = {}
         self.stored: set[str] = set()
-        self.mask: Any = None  # None == all-active; else bool (B,)
+        self.mask: Any = None  # None == all-active; else bool of self.shape
         self.return_mask: Any = None  # items that already returned
         self.global_size = global_size
         self.local_size = local_size
@@ -170,6 +178,17 @@ class _Ctx:
         self.gid = KVal(offset + idx, "int", affine=(1, 0))
         # padded-view cache for shifted slice loads: name -> {const: padded}
         self._pad_cache: dict[str, dict[int, Any]] = {}
+
+    def broadcast_scalar(self, val, dtype):
+        """Materialize a scalar as a full work-item vector of this ctx's
+        shape (subclasses may force a computed layout)."""
+        return jnp.full(self.shape, val, dtype=dtype)
+
+    def force_computed(self, vec):
+        """Hook for the Pallas subclass: rewrite a (possibly constant)
+        vector so Mosaic assigns it a non-replicated layout, making it a
+        legal while-loop carry.  Identity for the XLA lowering."""
+        return vec
 
     def padded_view(self, name: str, c: int):
         """Buffer padded so the shifted window [offset+c, offset+c+B) is
@@ -499,6 +518,8 @@ def _load(ctx: _Ctx, node: Index) -> KVal:
     idx = _eval(ctx, node.index)
     if idx.ctype not in _INT_TYPES:
         raise KernelLanguageError("array index must be an integer", line=node.line)
+    if ctx.pallas:
+        return ctx.pallas_load(node, buf, ctype, idx)  # type: ignore[attr-defined]
     if idx.affine is not None and idx.affine[0] == 1 and isinstance(idx.affine[1], int):
         c = idx.affine[1]
         if c == 0:
@@ -520,8 +541,11 @@ def _store(ctx: _Ctx, node: Index, val: KVal) -> None:
     ctype = ctx.buf_ctypes[node.base]
     v = _num(_as_dtype(val, ctype))
     if not hasattr(v, "ndim") or v.ndim == 0:
-        v = jnp.full((ctx.B,), v, dtype=ctype_to_dtype(ctype))
+        v = ctx.broadcast_scalar(v, ctype_to_dtype(ctype))
     idx = _eval(ctx, node.index)
+    if ctx.pallas:
+        ctx.pallas_store(node, buf, ctype, idx, v)  # type: ignore[attr-defined]
+        return
     m = ctx.active_mask()
     if (idx.affine is not None and idx.affine[0] == 1
             and isinstance(idx.affine[1], int) and m is None):
@@ -595,7 +619,7 @@ def _exec(ctx: _Ctx, node) -> None:
     if isinstance(node, Return):
         m = ctx.active_mask()
         if m is None:
-            m = jnp.ones((ctx.B,), jnp.bool_)
+            m = jnp.ones(ctx.shape, jnp.bool_)
         ctx.return_mask = m if ctx.return_mask is None else jnp.logical_or(ctx.return_mask, m)
         return
     raise KernelCompileError(f"cannot execute node {type(node).__name__}", line=getattr(node, "line", 0))
@@ -653,7 +677,7 @@ def _exec_if(ctx: _Ctx, node: If) -> None:
         return
 
     outer_mask = ctx.mask
-    cvec = jnp.broadcast_to(cond, (ctx.B,)) if (not hasattr(cond, "ndim") or cond.ndim == 0) else cond
+    cvec = jnp.broadcast_to(cond, ctx.shape) if (not hasattr(cond, "ndim") or cond.ndim == 0) else cond
 
     # early-return pattern: if (cond) return;
     then_mask = cvec if outer_mask is None else jnp.logical_and(outer_mask, cvec)
@@ -684,12 +708,15 @@ def _exec_loop(ctx: _Ctx, node) -> None:
 
     outer_mask = ctx.active_mask()
 
-    # broadcast carried locals to (B,) so loop-carry shapes are stable
+    # broadcast carried locals to the work-item shape so loop-carry shapes
+    # are stable (broadcast_scalar: the Pallas subclass forces a computed
+    # Mosaic layout — a jnp.full constant gets a replicated layout the
+    # body's computed carries cannot be relaid out to)
     for name in carried_vars:
         v = ctx.env[name]
         val = _num(v)
         if not hasattr(val, "ndim") or val.ndim == 0:
-            val = jnp.full((ctx.B,), val, dtype=ctype_to_dtype(v.ctype))
+            val = ctx.broadcast_scalar(val, ctype_to_dtype(v.ctype))
         ctx.env[name] = KVal(val, v.ctype, None)
 
     var_ctypes = {k: ctx.env[k].ctype for k in carried_vars}
@@ -703,7 +730,7 @@ def _exec_loop(ctx: _Ctx, node) -> None:
         c = _truthy(_eval(ctx, cond_expr))
         ctx.env, ctx.bufs, ctx.mask = saved_env, saved_bufs, saved_mask
         if not hasattr(c, "ndim") or c.ndim == 0:
-            c = jnp.broadcast_to(c, (ctx.B,))
+            c = jnp.broadcast_to(c, ctx.shape)
         return c
 
     init_env = {k: ctx.env[k].value for k in carried_vars}
@@ -712,12 +739,27 @@ def _exec_loop(ctx: _Ctx, node) -> None:
     if outer_mask is not None:
         active0 = jnp.logical_and(active0, outer_mask)
 
+    # Pallas/Mosaic: no bool array in a while-loop carry (relayout
+    # limitation — the same constraint the hand-written mandelbrot kernel
+    # works around, ops/mandelbrot.py); carry the mask as f32 0/1 and
+    # re-derive the bool inside the body
+    mask_in_carry_f32 = ctx.pallas
+
+    def to_carry_mask(m):
+        return ctx.force_computed(m.astype(jnp.float32)) if mask_in_carry_f32 else m
+
+    def from_carry_mask(m):
+        return (m > 0.0) if mask_in_carry_f32 else m
+
     def cond_fun(carry):
         active, _, _ = carry
+        if mask_in_carry_f32:
+            return jnp.sum(active) > 0.0
         return jnp.any(active)
 
     def body_fun(carry):
         active, env_vals, buf_vals = carry
+        active = from_carry_mask(active)
         saved_env, saved_bufs, saved_mask = dict(ctx.env), dict(ctx.bufs), ctx.mask
         saved_stored = set(ctx.stored)
         saved_rm = ctx.return_mask
@@ -743,14 +785,16 @@ def _exec_loop(ctx: _Ctx, node) -> None:
             for k in set(ctx.env.keys()) - env_keys_before:
                 del ctx.env[k]
             new_active = jnp.logical_and(active, eval_cond(new_env, new_bufs))
-            return (new_active, new_env, new_bufs)
+            return (to_carry_mask(new_active), new_env, new_bufs)
         finally:
             ctx.info["in_loop"] -= 1
             ctx.env, ctx.bufs, ctx.mask = saved_env, saved_bufs, saved_mask
             ctx.stored = saved_stored | ctx.stored
             ctx.return_mask = saved_rm
 
-    active_f, env_f, bufs_f = lax.while_loop(cond_fun, body_fun, (active0, init_env, init_bufs))
+    active_f, env_f, bufs_f = lax.while_loop(
+        cond_fun, body_fun, (to_carry_mask(active0), init_env, init_bufs)
+    )
     ctx._pad_cache.clear()
     for k in carried_vars:
         ctx.env[k] = KVal(env_f[k], var_ctypes[k], None)

@@ -1,0 +1,251 @@
+"""Pallas tile lowering for the kernel language — the TPU-native driver JIT.
+
+The XLA lowering (codegen.py) vectorizes a kernel over the whole launch
+chunk: every local variable becomes a ``(B,)`` array, and a ``while`` loop's
+state streams through HBM on EVERY iteration — for iteration-heavy kernels
+(mandelbrot's escape loop) that is HBM-bound and ~4-5x off the pace of a
+hand-tiled Pallas kernel whose state lives in VMEM (ops/mandelbrot.py;
+measured in BENCH_r03's ``codegen_vs_pallas``).
+
+This backend closes that gap for the ELEMENTWISE subset of the language:
+kernels whose every array access is ``buf[i]`` with ``i`` affine in
+``get_global_id(0)`` with stride 1 and zero shift (the dominant shape in
+the reference's kernel corpus — mandelbrot, stream add, saxpy, map-style
+kernels).  The SAME abstract interpreter runs inside a ``pallas_call``
+tile: work-item vectors become ``(rows, 128)`` VMEM blocks, the escape
+loop's carries stay on-chip, and per-tile ``while`` loops exit early the
+moment their tile's items are all done (the XLA lowering must run every
+iteration until the LAST item of the whole chunk finishes).
+
+Kernels outside the subset (shifted windows ``a[i+1]``, gathers ``x[j]``,
+scalar broadcasts ``a[0]``) raise :class:`PallasUnsupported` during a
+shape-only probe (``jax.eval_shape`` — no device work), and the registry
+falls back to the XLA lowering.  Mosaic constraints handled here, matching
+the hand kernel's workarounds: no bool arrays in while carries (masks ride
+as f32 0/1) and no replicated-layout (constant) carries (scalars broadcast
+through a computed zero).
+
+Reference mapping: this replaces the OpenCL driver JIT the reference
+delegates to (ClProgram.cs:62-73 createProgram → clBuildProgram); the
+tiling contract mirrors SURVEY.md §7 "step = 8*128 multiples".
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..errors import KernelCompileError
+from . import codegen, lang
+from .codegen import KVal, KernelBuildInfo, _Ctx, ctype_to_dtype
+
+__all__ = ["PallasUnsupported", "build_kernel_fn_pallas", "LANES"]
+
+LANES = 128          # TPU lane width
+DEFAULT_ROWS = 256   # tile rows per grid step (matches ops/mandelbrot.py)
+
+
+class PallasUnsupported(Exception):
+    """Kernel is outside the elementwise Pallas subset — use the XLA path."""
+
+
+class _PallasCtx(_Ctx):
+    """Interpreter context whose work-item vectors are (rows, 128) tiles."""
+
+    pallas = True
+
+    def __init__(self, rows: int, offset, global_size, local_size: int, info: dict):
+        super().__init__(rows * LANES, offset, global_size, local_size, info)
+        self.shape = (rows, LANES)
+        r = lax.broadcasted_iota(jnp.int32, self.shape, 0)
+        c = lax.broadcasted_iota(jnp.int32, self.shape, 1)
+        # offset already includes program_id * rows * LANES (see _tile_kernel)
+        self.gid = KVal(offset + r * LANES + c, "int", affine=(1, 0))
+        # computed zero: a FLOAT zero derived from the runtime offset —
+        # int x*0 folds algebraically back to a replicated constant, but
+        # float x*0.0 cannot be folded without a finiteness proof (the same
+        # trick as the hand kernel's `cx * 0.0`, ops/mandelbrot.py), so this
+        # keeps a materialized Mosaic layout
+        self._zero_f32 = self.gid.value.astype(jnp.float32) * 0.0
+
+    def broadcast_scalar(self, val, dtype):
+        # constant jnp.full gets a REPLICATED Mosaic layout that cannot be
+        # relaid out to the loop body's computed carries; adding through a
+        # computed zero forces a materialized layout
+        return self._zero_f32.astype(dtype) + jnp.asarray(val, dtype)
+
+    def force_computed(self, vec):
+        return self._zero_f32.astype(vec.dtype) + vec
+
+    def pallas_load(self, node: lang.Index, buf, ctype: str, idx: KVal) -> KVal:
+        if idx.affine is not None and idx.affine[0] == 1 and idx.affine[1] == 0:
+            return KVal(buf, ctype)
+        raise PallasUnsupported(
+            f"load {node.base}[...] is not elementwise (index must be "
+            f"get_global_id(0) exactly for the Pallas tile path)"
+        )
+
+    def pallas_store(self, node: lang.Index, buf, ctype: str, idx: KVal, v) -> None:
+        if not (idx.affine is not None and idx.affine[0] == 1 and idx.affine[1] == 0):
+            raise PallasUnsupported(
+                f"store {node.base}[...] is not elementwise"
+            )
+        m = self.active_mask()
+        if m is not None:
+            v = jnp.where(m, v, buf)
+        self.bufs[node.base] = v
+        self.stored.add(node.base)
+
+
+def _probe(kernel: lang.KernelDef, rows: int, local_size: int, global_size: int):
+    """Shape-only dry run of the tile interpreter: discovers which params
+    the kernel stores and raises :class:`PallasUnsupported` for any access
+    outside the elementwise subset.  No device work (jax.eval_shape)."""
+    array_params = [p for p in kernel.params if p.is_pointer]
+    value_params = [p for p in kernel.params if not p.is_pointer]
+    stored: list[str] = []
+
+    def run(offset, arrays, values):
+        ctx = _PallasCtx(rows, offset, global_size, local_size, {})
+        for p, arr in zip(array_params, arrays):
+            ctx.bufs[p.name] = arr
+            ctx.buf_ctypes[p.name] = p.ctype
+        for p, v in zip(value_params, values):
+            ctx.env[p.name] = KVal(v, p.ctype)
+        codegen._exec_block(ctx, kernel.body)
+        stored.extend(n for n in (p.name for p in array_params) if n in ctx.stored)
+        return tuple(ctx.bufs[p.name] for p in array_params)
+
+    shape = (rows, LANES)
+    arrays = tuple(
+        jax.ShapeDtypeStruct(shape, ctype_to_dtype(p.ctype)) for p in array_params
+    )
+    values = tuple(
+        jax.ShapeDtypeStruct((), ctype_to_dtype(p.ctype)) for p in value_params
+    )
+    jax.eval_shape(run, jax.ShapeDtypeStruct((), jnp.int32), arrays, values)
+    return stored
+
+
+def _tile_kernel(kernel: lang.KernelDef, rows: int, local_size: int,
+                 global_size: int, stored: list[str]):
+    """The pallas_call body: scalars arrive via SMEM (1,1) refs, array
+    tiles via VMEM refs; stored params write to output refs."""
+    array_params = [p for p in kernel.params if p.is_pointer]
+    value_params = [p for p in kernel.params if not p.is_pointer]
+    n_vals = len(value_params)
+
+    def body(*refs):
+        offset_ref = refs[0]
+        val_refs = refs[1 : 1 + n_vals]
+        in_refs = refs[1 + n_vals : 1 + n_vals + len(array_params)]
+        out_refs = refs[1 + n_vals + len(array_params) :]
+        base = offset_ref[0, 0] + pl_program_id() * rows * LANES
+        ctx = _PallasCtx(rows, base, global_size, local_size, {})
+        for p, r in zip(array_params, in_refs):
+            ctx.bufs[p.name] = r[:]
+            ctx.buf_ctypes[p.name] = p.ctype
+        for p, r in zip(value_params, val_refs):
+            ctx.env[p.name] = KVal(r[0, 0], p.ctype)
+        codegen._exec_block(ctx, kernel.body)
+        for name, r in zip(stored, out_refs):
+            r[:] = ctx.bufs[name]
+
+    return body
+
+
+def pl_program_id():
+    from jax.experimental import pallas as pl
+
+    return pl.program_id(0)
+
+
+def build_kernel_fn_pallas(
+    kernel: lang.KernelDef,
+    chunk: int,
+    local_size: int,
+    global_size: int,
+    block_rows: int = DEFAULT_ROWS,
+    interpret: bool = False,
+) -> tuple[Callable, KernelBuildInfo]:
+    """Build the Pallas tile launch function for one kernel geometry.
+
+    Same contract as :func:`codegen.build_kernel_fn`:
+    ``fn(offset, arrays_tuple, values_tuple) -> updated arrays tuple`` over
+    work items ``[offset, offset+chunk)`` with ``offset`` a runtime scalar.
+    Raises :class:`PallasUnsupported` if the kernel is outside the
+    elementwise subset or the chunk doesn't tile."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    if chunk % LANES != 0:
+        raise PallasUnsupported(f"chunk {chunk} not a multiple of {LANES}")
+    rows_total = chunk // LANES
+    rows = min(block_rows, rows_total)
+    while rows_total % rows != 0:
+        rows //= 2
+    rows = max(rows, 1)
+
+    stored = _probe(kernel, rows, local_size, global_size)
+
+    array_params = [p for p in kernel.params if p.is_pointer]
+    value_params = [p for p in kernel.params if not p.is_pointer]
+    info = KernelBuildInfo(
+        name=kernel.name,
+        array_params=[p.name for p in array_params],
+        value_params=[p.name for p in value_params],
+        array_ctypes={p.name: p.ctype for p in array_params},
+        stored_params=list(stored),
+    )
+    body = _tile_kernel(kernel, rows, local_size, global_size, stored)
+    grid = rows_total // rows
+    scalar_spec = pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM)
+    tile_spec = pl.BlockSpec((rows, LANES), lambda i: (i, 0))
+    stored_ix = {name: i for i, name in enumerate(info.array_params) if name in stored}
+
+    def fn(offset, arrays: tuple, values: tuple = ()):
+        if len(arrays) != len(array_params):
+            raise KernelCompileError(
+                f"kernel {kernel.name!r} takes {len(array_params)} array "
+                f"argument(s), got {len(arrays)}"
+            )
+        off = jnp.asarray(offset, jnp.int32)
+        # window [offset, offset+chunk) of every array param, tiled 2-D
+        windows = [
+            lax.dynamic_slice(arr, (off,), (chunk,)).reshape(rows_total, LANES)
+            for arr in arrays
+        ]
+        scalar_ops = [off.reshape(1, 1)] + [
+            jnp.asarray(v, ctype_to_dtype(p.ctype)).reshape(1, 1)
+            for p, v in zip(value_params, values)
+        ]
+        outs = pl.pallas_call(
+            body,
+            grid=(grid,),
+            in_specs=[scalar_spec] * len(scalar_ops) + [tile_spec] * len(windows),
+            out_specs=[tile_spec] * len(stored),
+            out_shape=[
+                jax.ShapeDtypeStruct(
+                    (rows_total, LANES), ctype_to_dtype(info.array_ctypes[n])
+                )
+                for n in stored
+            ],
+            interpret=interpret,
+        )(*scalar_ops, *windows)
+        if not isinstance(outs, (list, tuple)):
+            outs = [outs]
+        result = list(arrays)
+        for name, out in zip(stored, outs):
+            i = stored_ix[name]
+            flat = out.reshape(chunk)
+            if arrays[i].shape[0] == chunk:
+                result[i] = flat  # whole-buffer launch: the window IS the buffer
+            else:
+                result[i] = lax.dynamic_update_slice(arrays[i], flat, (off,))
+        return tuple(result)
+
+    return fn, info

@@ -132,20 +132,38 @@ class KernelProgram:
         chunk: int,
         local_size: int,
         global_size: int,
+        platform: str | None = None,
     ) -> tuple[Callable, Any]:
         """Get (building if needed) the jitted launch function for one
         geometry.  Signature: ``fn(offset, arrays_tuple, values_tuple) ->
-        updated arrays tuple``."""
-        key = (name, chunk, local_size, global_size)
+        updated arrays tuple``.
+
+        ``platform`` is the dispatch target's PJRT platform name
+        (``"tpu"``/``"cpu"``): on TPU, C-subset kernels in the elementwise
+        subset lower to Pallas tiles (kernel/pallas_backend.py — VMEM-
+        resident loop state, per-tile early exit) and fall back to the
+        vectorized XLA lowering otherwise."""
+        key = (name, chunk, local_size, global_size, platform)
         with self._lock:
             hit = self._cache.get(key)
         if hit is not None:
             return hit
 
         if name in self._c_kernels:
-            raw_fn, info = codegen.build_kernel_fn(
-                self._c_kernels[name], chunk, local_size, global_size
-            )
+            raw_fn = info = None
+            if platform == "tpu":
+                from . import pallas_backend
+
+                try:
+                    raw_fn, info = pallas_backend.build_kernel_fn_pallas(
+                        self._c_kernels[name], chunk, local_size, global_size
+                    )
+                except pallas_backend.PallasUnsupported:
+                    raw_fn = None
+            if raw_fn is None:
+                raw_fn, info = codegen.build_kernel_fn(
+                    self._c_kernels[name], chunk, local_size, global_size
+                )
         elif name in self._py_kernels:
             pk = self._py_kernels[name]
 
@@ -188,6 +206,7 @@ class KernelProgram:
         repeats: int,
         sync_kernel: str | None,
         value_args,
+        platform: str | None = None,
     ) -> Callable | None:
         """One jitted function running the whole kernel sequence over the
         launch ladder ``repeats`` times as an on-device ``lax.fori_loop`` —
@@ -210,7 +229,8 @@ class KernelProgram:
         all_names = set(names) | ({sync_kernel} if sync_kernel else set())
         try:
             sig = tuple(sorted((n, vals_for(n)) for n in all_names))
-            key = ("seq", names, chunks, local_size, global_size, repeats, sync_kernel, sig)
+            key = ("seq", names, chunks, local_size, global_size, repeats,
+                   sync_kernel, sig, platform)
             with self._lock:
                 hit = self._cache.get(key)
         except TypeError:
@@ -223,7 +243,7 @@ class KernelProgram:
                 off = offset0
                 n_arr = self.array_param_count(name)
                 for chunk in chunks:
-                    fn, _ = self.launcher(name, chunk, local_size, global_size)
+                    fn, _ = self.launcher(name, chunk, local_size, global_size, platform)
                     out = fn(off, bufs[:n_arr], vals_for(name))
                     bufs = tuple(out) + bufs[n_arr:]
                     off = off + chunk

@@ -73,6 +73,29 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     for name in ("ck_markersAdded", "ck_markersReached", "ck_markersRemaining"):
         getattr(lib, name).argtypes = [i64]
         getattr(lib, name).restype = i64
+    # events (ClEvent/ClUserEvent parity)
+    lib.ck_eventCreate.argtypes = []
+    lib.ck_eventCreate.restype = i64
+    for name in ("ck_eventDelete", "ck_eventTrigger", "ck_eventIncrement", "ck_eventDecrement"):
+        getattr(lib, name).argtypes = [i64]
+        getattr(lib, name).restype = None
+    lib.ck_eventFired.argtypes = [i64]
+    lib.ck_eventFired.restype = ctypes.c_int
+    lib.ck_eventWait.argtypes = [i64, i64]
+    lib.ck_eventWait.restype = ctypes.c_int
+    lib.ck_eventPending.argtypes = [i64]
+    lib.ck_eventPending.restype = i64
+    # async copy engine
+    lib.ck_copyEngineStart.argtypes = [ctypes.c_int]
+    lib.ck_copyEngineStart.restype = None
+    lib.ck_copyEngineThreads.argtypes = []
+    lib.ck_copyEngineThreads.restype = ctypes.c_int
+    lib.ck_copyEngineQueued.argtypes = []
+    lib.ck_copyEngineQueued.restype = i64
+    lib.ck_copyAsync.argtypes = [p, p, i64, i64]
+    lib.ck_copyAsync.restype = None
+    lib.ck_copyParallel.argtypes = [p, p, i64, ctypes.c_int]
+    lib.ck_copyParallel.restype = None
     return lib
 
 
@@ -94,7 +117,7 @@ def load() -> ctypes.CDLL | None:
                     _load_failed = True
                     return None
             lib = ctypes.CDLL(str(_LIB))
-            if lib.ck_abiVersion() != 1:
+            if lib.ck_abiVersion() != 2:
                 raise OSError("ABI mismatch")
             _lib = _bind(lib)
             return _lib

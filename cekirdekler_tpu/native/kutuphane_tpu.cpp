@@ -3,28 +3,49 @@
 // TPU-native replacement for the capabilities the reference keeps in its
 // C++ KutuphaneCL.dll host-array layer (contract recovered from the P/Invoke
 // surface at CSpaceArrays.cs:108-147: sizeOf / createArray / alignedArrHead /
-// deleteArray / copyMemory) plus the command-queue marker counters
+// deleteArray / copyMemory), the command-queue marker counters
 // (ClCommandQueue.cs:99-115: addMarkerToCommandQueue /
-// getMarkerCounterOfCommandQueue / resetMarkerCounterOfCommandQueue).
+// getMarkerCounterOfCommandQueue / resetMarkerCounterOfCommandQueue),
+// the event objects (ClEvent.cs:30-34 createEvent/deleteEvent;
+// ClUserEvent.cs:30-47 createUserEvent/triggerUserEvent/
+// incrementUserEvent/decrementUserEvent), and the host side of the async
+// copy machinery (ClBuffer.cs:316-475 event-carrying enqueueRead/Write —
+// here a worker-thread copy engine whose jobs complete native events).
 //
 // Provides:
 //   * page-aligned host allocations (4096 B like the reference) for
 //     fast, DMA-friendly host staging buffers ("FastArr" backing store),
 //   * bulk memcpy / fill helpers that release the Python GIL implicitly
 //     (plain C calls through ctypes),
+//   * condition-variable events with user-event counter semantics,
+//   * an async copy engine: N worker threads draining a job queue, each
+//     job a memcpy completing an event — host staging copies overlap
+//     Python-side work and each other (the GIL is released for the whole
+//     ctypes call),
+//   * a parallel synchronous copy (range split across the pool) for big
+//     D2H writebacks,
 //   * atomic marker counters used for fine-grained progress observation by
 //     the pool scheduler and enqueue mode,
 //   * allocation statistics for leak tests.
 //
-// Exposed as flat C symbols consumed via ctypes (arrays/fastarr.py).
+// Exposed as flat C symbols consumed via ctypes (arrays/fastarr.py,
+// native/build.py).  See native/DESIGN.md for the tier boundary: why the
+// device path itself stays behind JAX/XLA's PJRT client rather than a
+// bespoke PJRT C-API client.
 
 #include <atomic>
+#include <chrono>
+#include <condition_variable>
 #include <cstdint>
 #include <cstdlib>
 #include <cstring>
+#include <deque>
 #include <map>
+#include <memory>
 #include <mutex>
 #include <new>
+#include <thread>
+#include <vector>
 
 #if defined(_WIN32)
 #define EXPORT extern "C" __declspec(dllexport)
@@ -212,5 +233,266 @@ EXPORT void ck_resetMarkerCounter(std::int64_t id) {
   }
 }
 
+// ---------------------------------------------------------------------------
+// events (reference: ClEvent.cs:30-34 createEvent/deleteEvent;
+// ClUserEvent.cs:30-47 createUserEvent/triggerUserEvent/addUserEvent/
+// incrementUserEvent/decrementUserEvent).  A user event is an event with a
+// pending counter: it fires when the counter reaches zero (or on an
+// explicit trigger), releasing every waiter — the host-gated dispatch
+// primitive behind Worker.cs:487-557's synchronized queue start.
+// ---------------------------------------------------------------------------
+
+namespace {
+
+struct Event {
+  std::mutex m;
+  std::condition_variable cv;
+  bool fired = false;
+  std::int64_t pending = 0;  // user-event counter; fires when it hits 0
+
+  void trigger() {
+    {
+      std::lock_guard<std::mutex> lock(m);
+      fired = true;
+    }
+    cv.notify_all();
+  }
+
+  bool wait(std::int64_t timeout_ms) {
+    std::unique_lock<std::mutex> lock(m);
+    if (timeout_ms < 0) {
+      cv.wait(lock, [this] { return fired; });
+      return true;
+    }
+    return cv.wait_for(lock, std::chrono::milliseconds(timeout_ms),
+                       [this] { return fired; });
+  }
+};
+
+// shared_ptr ownership: find_event returns a reference-holding copy, so a
+// waiter blocked inside Event::wait keeps the object alive even if another
+// thread deletes the id concurrently — no use-after-free window
+std::mutex g_event_mutex;
+std::map<std::int64_t, std::shared_ptr<Event>> g_events;
+std::int64_t g_next_event_id = 1;
+
+std::shared_ptr<Event> find_event(std::int64_t id) {
+  std::lock_guard<std::mutex> lock(g_event_mutex);
+  auto it = g_events.find(id);
+  return it == g_events.end() ? nullptr : it->second;
+}
+
+}  // namespace
+
+EXPORT std::int64_t ck_eventCreate() {
+  std::lock_guard<std::mutex> lock(g_event_mutex);
+  std::int64_t id = g_next_event_id++;
+  g_events[id] = std::make_shared<Event>();
+  return id;
+}
+
+EXPORT void ck_eventDelete(std::int64_t id) {
+  std::shared_ptr<Event> e;
+  {
+    std::lock_guard<std::mutex> lock(g_event_mutex);
+    auto it = g_events.find(id);
+    if (it == g_events.end()) return;
+    e = it->second;
+    g_events.erase(it);
+  }
+  e->trigger();  // never leave a waiter stuck on a deleted event
+  // e's refcount drops when the last waiter returns from wait()
+}
+
+EXPORT void ck_eventTrigger(std::int64_t id) {
+  if (auto e = find_event(id)) e->trigger();
+}
+
+EXPORT int ck_eventFired(std::int64_t id) {
+  auto e = find_event(id);
+  if (e == nullptr) return -1;
+  std::lock_guard<std::mutex> lock(e->m);
+  return e->fired ? 1 : 0;
+}
+
+// blocks WITHOUT the GIL (ctypes releases it): Python threads keep running
+EXPORT int ck_eventWait(std::int64_t id, std::int64_t timeout_ms) {
+  auto e = find_event(id);
+  if (e == nullptr) return -1;
+  return e->wait(timeout_ms) ? 1 : 0;
+}
+
+EXPORT void ck_eventIncrement(std::int64_t id) {
+  if (auto e = find_event(id)) {
+    std::lock_guard<std::mutex> lock(e->m);
+    e->pending += 1;
+  }
+}
+
+EXPORT void ck_eventDecrement(std::int64_t id) {
+  auto e = find_event(id);
+  if (e == nullptr) return;
+  bool fire = false;
+  {
+    std::lock_guard<std::mutex> lock(e->m);
+    e->pending -= 1;
+    if (e->pending <= 0 && !e->fired) {
+      e->fired = true;
+      fire = true;
+    }
+  }
+  if (fire) e->cv.notify_all();
+}
+
+EXPORT std::int64_t ck_eventPending(std::int64_t id) {
+  auto e = find_event(id);
+  if (e == nullptr) return -1;
+  std::lock_guard<std::mutex> lock(e->m);
+  return e->pending;
+}
+
+// ---------------------------------------------------------------------------
+// async copy engine (reference: the event-carrying enqueueRead/Write family,
+// ClBuffer.cs:316-475 — host-side staging copies run on dedicated threads
+// and complete events; the device DMA itself belongs to the PJRT/XLA layer,
+// see DESIGN.md)
+// ---------------------------------------------------------------------------
+
+namespace {
+
+struct CopyJob {
+  void* dst;
+  const void* src;
+  std::int64_t bytes;
+  std::int64_t event_id;   // 0 = none
+  bool decrement = false;  // true: decrement the event's counter instead of
+                           // triggering it (ck_copyParallel fan-in)
+};
+
+class CopyEngine {
+ public:
+  static CopyEngine& instance() {
+    // intentionally leaked: destroying joinable std::threads at static
+    // teardown calls std::terminate; process exit reaps them instead
+    static CopyEngine* engine = new CopyEngine();
+    return *engine;
+  }
+
+  void ensure_started(int threads) {
+    std::lock_guard<std::mutex> lock(m_);
+    if (!workers_.empty()) return;
+    int n = threads > 0 ? threads : 4;
+    stop_ = false;
+    for (int i = 0; i < n; ++i) {
+      workers_.emplace_back([this] { run(); });
+    }
+  }
+
+  void submit(const CopyJob& job) {
+    {
+      std::lock_guard<std::mutex> lock(m_);
+      jobs_.push_back(job);
+    }
+    cv_.notify_one();
+  }
+
+  std::int64_t queued() {
+    std::lock_guard<std::mutex> lock(m_);
+    return static_cast<std::int64_t>(jobs_.size()) + active_;
+  }
+
+  int thread_count() {
+    std::lock_guard<std::mutex> lock(m_);
+    return static_cast<int>(workers_.size());
+  }
+
+ private:
+  void run() {
+    for (;;) {
+      CopyJob job;
+      {
+        std::unique_lock<std::mutex> lock(m_);
+        cv_.wait(lock, [this] { return stop_ || !jobs_.empty(); });
+        if (stop_ && jobs_.empty()) return;
+        job = jobs_.front();
+        jobs_.pop_front();
+        ++active_;
+      }
+      if (job.dst != nullptr && job.src != nullptr && job.bytes > 0) {
+        std::memcpy(job.dst, job.src, static_cast<std::size_t>(job.bytes));
+      }
+      if (job.event_id != 0) {
+        if (job.decrement) {
+          ck_eventDecrement(job.event_id);
+        } else {
+          ck_eventTrigger(job.event_id);
+        }
+      }
+      {
+        std::lock_guard<std::mutex> lock(m_);
+        --active_;
+      }
+    }
+  }
+
+  std::mutex m_;
+  std::condition_variable cv_;
+  std::deque<CopyJob> jobs_;
+  std::vector<std::thread> workers_;
+  std::int64_t active_ = 0;
+  bool stop_ = false;
+};
+
+}  // namespace
+
+EXPORT void ck_copyEngineStart(int threads) {
+  CopyEngine::instance().ensure_started(threads);
+}
+
+EXPORT int ck_copyEngineThreads() {
+  return CopyEngine::instance().thread_count();
+}
+
+EXPORT std::int64_t ck_copyEngineQueued() {
+  return CopyEngine::instance().queued();
+}
+
+// async: returns immediately; triggers event_id (if nonzero) on completion
+EXPORT void ck_copyAsync(void* dst, const void* src, std::int64_t num_bytes,
+                         std::int64_t event_id) {
+  CopyEngine::instance().ensure_started(0);
+  CopyEngine::instance().submit(CopyJob{dst, src, num_bytes, event_id});
+}
+
+// synchronous parallel copy: the range is split into chunks fanned out to
+// the CopyEngine pool (no per-call thread spawn), joined through a
+// counting event.  Used for big writebacks — the whole call runs GIL-free
+// and saturates host memory bandwidth better than a single memcpy for
+// multi-MB slices.
+EXPORT void ck_copyParallel(void* dst, const void* src, std::int64_t num_bytes,
+                            int threads) {
+  if (dst == nullptr || src == nullptr || num_bytes <= 0) return;
+  int n = threads > 1 ? threads : 2;
+  constexpr std::int64_t kMinChunk = 1 << 20;  // <1 MiB/chunk isn't worth it
+  if (num_bytes < 2 * kMinChunk) {
+    std::memcpy(dst, src, static_cast<std::size_t>(num_bytes));
+    return;
+  }
+  if (num_bytes / n < kMinChunk) n = static_cast<int>(num_bytes / kMinChunk);
+  CopyEngine::instance().ensure_started(0);
+  std::int64_t ev = ck_eventCreate();
+  for (int i = 0; i < n; ++i) ck_eventIncrement(ev);
+  std::int64_t chunk = num_bytes / n;
+  for (int i = 0; i < n; ++i) {
+    std::int64_t off = i * chunk;
+    std::int64_t len = (i == n - 1) ? num_bytes - off : chunk;
+    CopyEngine::instance().submit(CopyJob{static_cast<char*>(dst) + off,
+                                          static_cast<const char*>(src) + off,
+                                          len, ev, /*decrement=*/true});
+  }
+  ck_eventWait(ev, -1);
+  ck_eventDelete(ev);
+}
+
 // ABI sanity probe for the ctypes loader.
-EXPORT std::int64_t ck_abiVersion() { return 1; }
+EXPORT std::int64_t ck_abiVersion() { return 2; }

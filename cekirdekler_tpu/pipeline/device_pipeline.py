@@ -165,7 +165,12 @@ class PipelineStage:
                 else tuple(self.values)
             )
             fn, _ = self.program.launcher(
-                name, self.global_range, self.local_range, self.global_range
+                name, self.global_range, self.local_range, self.global_range,
+                platform=(
+                    self.device.jax_device.platform
+                    if self.device is not None
+                    else None
+                ),
             )
             n_arr = self.program.array_param_count(name)
             out = fn(offset, bufs[:n_arr], tuple(va))

@@ -1,0 +1,96 @@
+"""Host-controlled events — the ClEvent / ClUserEvent analogue.
+
+Reference: ClUserEvent.cs:29-121 — a host-triggered event with an attached
+counter, bound to command queues so enqueued work HOLDS until the host
+triggers it; Worker.cs:487-557 uses it for a synchronized start across all
+of a device's queues.  Here the native tier (kutuphane_tpu.cpp, events
+section) provides the condition-variable object — waits through ctypes run
+WITHOUT the GIL — with a pure-Python fallback when the toolchain is
+unavailable.
+
+The dispatch-gating use (``NumberCruncher.dispatch_gate``): every worker
+lane blocks on the event at the top of its compute phase, so triggering
+starts all lanes simultaneously — the reference's synchronized queue
+start, with TPU dispatch lanes in place of OpenCL queues.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from ..native import load as _native_load
+
+__all__ = ["UserEvent"]
+
+
+class UserEvent:
+    """Host-triggered gate with a pending counter (fires at zero).
+
+    ``increment``/``decrement`` mirror the reference's counter semantics
+    (ClUserEvent.cs:49-117): hold the gate open for N contributors, fire
+    when the last one decrements — or fire immediately with ``trigger()``.
+    """
+
+    def __init__(self):
+        self._lib = _native_load()
+        if self._lib is not None:
+            self._id = self._lib.ck_eventCreate()
+            self._ev = None
+        else:
+            self._id = 0
+            self._ev = threading.Event()
+            self._pending = 0
+            self._lock = threading.Lock()
+
+    # -- native/fallback split ------------------------------------------------
+    def trigger(self) -> None:
+        if self._lib is not None:
+            self._lib.ck_eventTrigger(self._id)
+        else:
+            self._ev.set()
+
+    def fired(self) -> bool:
+        if self._lib is not None:
+            return self._lib.ck_eventFired(self._id) == 1
+        return self._ev.is_set()
+
+    def wait(self, timeout: float | None = None) -> bool:
+        """Block until triggered (GIL-free under the native tier)."""
+        if self._lib is not None:
+            ms = -1 if timeout is None else int(timeout * 1000)
+            return self._lib.ck_eventWait(self._id, ms) == 1
+        return self._ev.wait(timeout)
+
+    def increment(self) -> None:
+        if self._lib is not None:
+            self._lib.ck_eventIncrement(self._id)
+        else:
+            with self._lock:
+                self._pending += 1
+
+    def decrement(self) -> None:
+        """Decrement the pending counter; fires the event at zero."""
+        if self._lib is not None:
+            self._lib.ck_eventDecrement(self._id)
+        else:
+            with self._lock:
+                self._pending -= 1
+                if self._pending <= 0:
+                    self._ev.set()
+
+    def pending(self) -> int:
+        if self._lib is not None:
+            return int(self._lib.ck_eventPending(self._id))
+        with self._lock:
+            return self._pending
+
+    def close(self) -> None:
+        if self._lib is not None and self._id:
+            self._lib.ck_eventDelete(self._id)
+            self._id = 0
+
+    def __del__(self):  # best-effort; explicit close preferred
+        try:
+            self.close()
+        except Exception:
+            pass

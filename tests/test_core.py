@@ -452,3 +452,37 @@ def test_enqueue_rebalance_reacquired_range_not_stale(devs):
     cr.enqueue_mode = False
     np.testing.assert_allclose(np.asarray(x), float(total))
     cr.dispose()
+
+
+def test_dispatch_gate_synchronized_start(devs):
+    """Host-gated dispatch (ClUserEvent analogue): compute() issued from a
+    worker thread holds until the host triggers the gate, then all lanes
+    start (reference: Worker.cs:487-557 synchronized queue start)."""
+    import threading
+    import time as _t
+
+    from cekirdekler_tpu.utils.events import UserEvent
+
+    cr = NumberCruncher(devs.subset(2), VADD)
+    x = ClArray(np.zeros(512, np.float32), name="x")
+    x.partial_read = True
+    gate = UserEvent()
+    cr.dispatch_gate = gate
+    done = threading.Event()
+
+    def run():
+        x.compute(cr, 21, "inc", 512, 64)
+        done.set()
+
+    t = threading.Thread(target=run)
+    t.start()
+    _t.sleep(0.15)
+    assert not done.is_set(), "compute must hold until the gate fires"
+    assert np.all(np.asarray(x) == 0.0)
+    gate.trigger()
+    t.join(timeout=10.0)
+    assert done.is_set()
+    np.testing.assert_allclose(np.asarray(x), 1.0)
+    cr.dispatch_gate = None
+    gate.close()
+    cr.dispose()

@@ -178,3 +178,51 @@ def test_timeline_capture_propagates_region_exception(tmp_path):
     with pytest.raises(ValueError, match="real error"):
         with timeline.capture(str(tmp_path / "tr")):
             raise ValueError("real error")
+
+
+def test_user_event_counter_semantics():
+    """ClUserEvent parity: fires on explicit trigger OR when the pending
+    counter decrements to zero; waiters release (native path when the
+    toolchain is present, threading fallback otherwise)."""
+    from cekirdekler_tpu.utils.events import UserEvent
+
+    ev = UserEvent()
+    assert not ev.fired()
+    ev.increment()
+    ev.increment()
+    assert ev.pending() == 2
+    ev.decrement()
+    assert not ev.fired()
+    ev.decrement()
+    assert ev.fired()
+    assert ev.wait(timeout=1.0)
+    ev.close()
+
+    ev2 = UserEvent()
+    assert not ev2.wait(timeout=0.05)  # times out untriggered
+    ev2.trigger()
+    assert ev2.wait(timeout=1.0)
+    ev2.close()
+
+
+def test_native_copy_engine_async_and_parallel():
+    import numpy as np
+
+    from cekirdekler_tpu import native
+    from cekirdekler_tpu.utils.events import UserEvent
+
+    lib = native.load()
+    if lib is None:
+        import pytest
+
+        pytest.skip("native toolchain unavailable")
+    src = np.arange(1 << 21, dtype=np.float32)  # 8 MiB
+    dst = np.zeros_like(src)
+    ev = UserEvent()
+    lib.ck_copyAsync(dst.ctypes.data, src.ctypes.data, src.nbytes, ev._id)
+    assert ev.wait(timeout=5.0)
+    np.testing.assert_array_equal(dst, src)
+    dst2 = np.zeros_like(src)
+    lib.ck_copyParallel(dst2.ctypes.data, src.ctypes.data, src.nbytes, 4)
+    np.testing.assert_array_equal(dst2, src)
+    ev.close()
