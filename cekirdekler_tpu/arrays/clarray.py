@@ -245,6 +245,36 @@ class ClArray(_ComputeMixin):
             self._np = np.ascontiguousarray(arr)
         self.flags = TransferFlags(**flag_overrides)
         self.name = name or f"arr@{id(self):x}"
+        # set by wrap_structs: the structured array this byte view aliases
+        self._struct_source: np.ndarray | None = None
+
+    @classmethod
+    def wrap_structs(cls, arr: np.ndarray, name: str | None = None,
+                     **flag_overrides) -> "ClArray":
+        """Wrap a numpy STRUCTURED array as a byte ClArray, zero-copy
+        (reference: wrapArrayOfStructs via GCHandle pinning,
+        ClArray.cs:1058-1074 + HelperFunctions.cs:53-82).
+
+        The byte view aliases the caller's array — device writes flushed to
+        host appear in the original structs with no conversion.  One work
+        item maps to one struct: ``elements_per_work_item`` is set to the
+        struct's byte size, so compute ranges count structs while transfers
+        move their bytes (the reference's numberOfElementsPerWorkItem
+        pattern for struct arrays)."""
+        if arr.dtype.fields is None:
+            raise ValueError("wrap_structs expects a numpy structured array")
+        if not arr.flags["C_CONTIGUOUS"]:
+            raise ValueError("structured array must be C-contiguous to alias")
+        view = arr.view(np.uint8).reshape(-1)
+        flag_overrides.setdefault("elements_per_work_item", arr.dtype.itemsize)
+        out = cls(view, name=name or "structs", **flag_overrides)
+        out._struct_source = arr
+        return out
+
+    @property
+    def struct_source(self) -> np.ndarray | None:
+        """The structured array a wrap_structs ClArray aliases (or None)."""
+        return self._struct_source
 
     # -- backing store -------------------------------------------------------
     @property

@@ -74,6 +74,7 @@ class Device:
 
     jax_device: jax.Device
     partition_cores: int = 0  # >0 => virtual sub-device (CPU fission analogue)
+    partition_id: int = 0     # lane index among partitions of one chip
 
     @property
     def platform(self) -> str:
@@ -81,7 +82,10 @@ class Device:
 
     @property
     def name(self) -> str:
-        return f"{self.jax_device.device_kind} #{self.jax_device.id}"
+        base = f"{self.jax_device.device_kind} #{self.jax_device.id}"
+        if self.partition_cores:
+            return f"{base}/p{self.partition_id}"
+        return base
 
     @property
     def vendor(self) -> str:
@@ -131,7 +135,26 @@ class Device:
         return 0
 
     def copy(self) -> "Device":
-        return Device(self.jax_device, self.partition_cores)
+        return Device(self.jax_device, self.partition_cores, self.partition_id)
+
+    def as_partitions(self, num: int) -> "Devices":
+        """Split this chip into ``num`` virtual sub-devices (reference:
+        ``createDeviceAsPartition`` — CPU device fission into sub-devices,
+        ClDevice.cs:85-95).  Each partition is a distinct scheduler lane
+        dispatching to the SAME chip: the balancer splits the range across
+        them and XLA interleaves their async streams — the TPU-idiomatic
+        reading of device fission (SURVEY.md §2.3: subslice / virtual-device
+        counts)."""
+        if num <= 0:
+            raise ValueError("partition count must be positive")
+        cores = max(1, self.compute_units // num)
+        return Devices(
+            Device(self.jax_device, cores, i) for i in range(num)
+        )
+
+    @property
+    def is_partition(self) -> bool:
+        return self.partition_cores > 0
 
     def log_info(self) -> str:
         mem = self.memory_bytes
@@ -166,10 +189,12 @@ class Devices(Sequence[Device]):
         return self._devices[idx].copy()
 
     def __add__(self, other: "Devices") -> "Devices":
-        seen: set[int] = set()
+        seen: set[tuple] = set()
         out: list[Device] = []
         for d in list(self._devices) + list(other._devices):
-            key = id(d.jax_device)
+            # partitions of one chip are DISTINCT lanes — dedup must not
+            # collapse them (only true duplicates of the same lane)
+            key = (id(d.jax_device), d.partition_cores, d.partition_id)
             if key not in seen:
                 seen.add(key)
                 out.append(d.copy())

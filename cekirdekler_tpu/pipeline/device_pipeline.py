@@ -81,6 +81,7 @@ class PipelineStage:
         local_range: int = 256,
         values: Sequence | dict = (),
         init_kernels: str | Sequence[str] = (),
+        devices=None,
     ):
         self.program = KernelProgram(kernel_source)
         self.kernels = kernels.split() if isinstance(kernels, str) else list(kernels)
@@ -95,6 +96,14 @@ class PipelineStage:
         self.outputs: list[_Slot] = []
         self.transitions: list[_Slot] = []
         self.device: Device | None = None
+        # multi-chip stage (reference: a stage owns its own cruncher over a
+        # ClDevices set, ClPipeline.cs:225-285): when set, this stage runs
+        # its kernels through a stage-local Cores — range load-balanced
+        # across ITS devices — instead of a single-chip launcher.
+        # Normalized so an empty sequence means "unassigned" everywhere
+        # (make() counts and allocates on the same condition).
+        self.devices = devices if devices is not None and len(devices) > 0 else None
+        self._cores = None
         self.prev: "PipelineStage | None" = None
         self.next: "PipelineStage | None" = None
         self.elapsed_ms = 0.0
@@ -154,8 +163,13 @@ class PipelineStage:
         """Launch the kernel sequence on the stage's device values."""
         import time
 
+        if self._cores is not None:
+            self._run_multi(kernel_names)
+            return
         t0 = time.perf_counter()
         slots = self._slots()
+        # placement ownership: every producer of a single-chip stage's slot
+        # values (push/_bind/handoff) device_puts before we get here
         bufs = tuple(s.value for s in slots)
         offset = 0
         for name in kernel_names:
@@ -177,6 +191,33 @@ class PipelineStage:
             bufs = tuple(out) + bufs[n_arr:]
         for s, b in zip(slots, bufs):
             s.value = b
+        self.elapsed_ms = (time.perf_counter() - t0) * 1000.0
+
+    def _run_multi(self, kernel_names: list[str]) -> None:
+        """Multi-chip stage body: pull incoming device values to host, run
+        the kernels through the stage's own Cores (per-chip range split +
+        load balancing), publish host arrays as the stage's new values —
+        the reference's behavior exactly (each stage.run() is a full
+        H2D/compute/D2H on that stage's devices; stage→stage data moves
+        through host arrays, ClPipeline.cs:287-603,624-1580)."""
+        import time
+
+        t0 = time.perf_counter()
+        slots = self._slots()
+        for s in slots:
+            if s.value is not None and not isinstance(s.value, np.ndarray):
+                np.copyto(s.arr.host(), np.asarray(s.value), casting="unsafe")
+                s.value = None
+            elif isinstance(s.value, np.ndarray) and s.value is not s.arr.host():
+                np.copyto(s.arr.host(), s.value, casting="unsafe")
+                s.value = None
+        params = [s.arr for s in slots]
+        self._cores.compute(
+            kernel_names, params, 1, self.global_range, self.local_range,
+            value_args=self.values,
+        )
+        for s in self.outputs + self.transitions:
+            s.value = s.arr.host()
         self.elapsed_ms = (time.perf_counter() - t0) * 1000.0
 
 
@@ -204,19 +245,29 @@ class ClPipeline:
         if not stages:
             raise CekirdeklerError("pipeline needs at least one stage")
         devices = list(devices)
+        unassigned = [st for st in stages if st.devices is None]
         if len(devices) == 1:
-            # single-chip pipeline: every stage on the one device
-            devices = devices * len(stages)
-        if len(devices) < len(stages):
+            # single-chip pipeline: every unassigned stage on the one device
+            devices = devices * len(unassigned)
+        if len(devices) < len(unassigned):
             raise CekirdeklerError(
-                f"{len(stages)} stages need {len(stages)} devices (or exactly 1 "
-                f"for a single-chip pipeline); got {len(devices)}"
+                f"{len(unassigned)} stages need {len(unassigned)} devices (or "
+                f"exactly 1 for a single-chip pipeline); got {len(devices)}"
             )
-        for i, (st, d) in enumerate(zip(stages, devices)):
-            st.device = d
+        dev_iter = iter(devices)
+        for i, st in enumerate(stages):
             if i > 0:
                 st.prev, stages[i - 1].next = stages[i - 1], st
-            st._bind(d.jax_device)
+            if st.devices is not None:
+                # multi-chip stage: its own Cores over its device set
+                # (reference: per-stage cruncher, ClPipeline.cs:225-285)
+                from ..core.cores import Cores
+
+                st._cores = Cores(st.devices, st.program)
+                st.device = st.devices[0]
+            else:
+                st.device = next(dev_iter)
+                st._bind(st.device.jax_device)
             for s in st._slots():
                 if s.arr.size < st.global_range:
                     raise ComputeValidationError(
@@ -267,7 +318,12 @@ class ClPipeline:
                 )
             for slot, d in zip(first.inputs, datas):
                 host = d.host() if isinstance(d, ClArray) else np.asarray(d)
-                slot.value = jax.device_put(host, first.device.jax_device)
+                if first._cores is not None:
+                    # multi-chip stage consumes host data directly
+                    np.copyto(slot.arr.host(), host, casting="unsafe")
+                    slot.value = None
+                else:
+                    slot.value = jax.device_put(host, first.device.jax_device)
 
         # all stages compute concurrently on their current values
         futures = [self._pool.submit(st._run, st.kernels) for st in self.stages]
@@ -295,19 +351,26 @@ class ClPipeline:
         links move first; stages without transitions fall back to by-index
         output→input forwarding.  Same-chip handoff is a free value move;
         cross-chip rides ICI via ``device_put``."""
+        def handoff(v, nxt):
+            if nxt._cores is not None:
+                # multi-chip consumer takes host data (its compute uploads
+                # per-chip range slices from it).  ALWAYS a snapshot: a
+                # multi-chip producer publishes its live arr.host() buffer,
+                # which its own next-generation compute will overwrite
+                # concurrently with the consumer's read
+                return np.array(v)
+            return jax.device_put(v, nxt.device.jax_device)
+
         for st in self.stages[:-1]:
             nxt = st.next
             links = getattr(st, "_transition_links", [])
             if links:
                 for src, dst in links:
-                    v = src.value
-                    if st.device is not nxt.device:
-                        v = jax.device_put(v, nxt.device.jax_device)
-                    dst.value = v
+                    dst.value = handoff(src.value, nxt)
                 continue
             n = min(len(st.outputs), len(nxt.inputs))
             for o_slot, i_slot in zip(st.outputs[:n], nxt.inputs[:n]):
-                i_slot.value = jax.device_put(o_slot.value, nxt.device.jax_device)
+                i_slot.value = handoff(o_slot.value, nxt)
 
     def performance_report(self) -> str:
         lines = ["pipeline stages:"]
@@ -321,6 +384,9 @@ class ClPipeline:
     def dispose(self) -> None:
         self._pool.shutdown(wait=False)
         for st in self.stages:
+            if st._cores is not None:
+                st._cores.dispose()
+                st._cores = None
             for s in st._slots():
                 s.value = None
 

@@ -144,3 +144,81 @@ def test_wrap_coercions():
     assert w.fast_arr
     a = ClArray(4)
     assert wrap(a) is a
+
+
+def test_wrap_structs_roundtrip_through_compute():
+    """Struct arrays (reference: wrapArrayOfStructs, ClArray.cs:1058-1074):
+    a structured array wraps zero-copy as bytes, one work item per struct,
+    and device writes land back in the original struct fields."""
+    import numpy as np
+
+    from cekirdekler_tpu import ClArray
+    from cekirdekler_tpu.core.cruncher import NumberCruncher
+    from cekirdekler_tpu.hardware import platforms
+
+    dt = np.dtype([("a", "<f4"), ("b", "<i4")])
+    recs = np.zeros(256, dt)
+    recs["a"] = np.arange(256, dtype=np.float32)
+    recs["b"] = np.arange(256)
+
+    wrapped = ClArray.wrap_structs(recs, name="recs", partial_read=True)
+    assert wrapped.size == 256 * dt.itemsize
+    assert wrapped.flags.elements_per_work_item == dt.itemsize
+    assert wrapped.struct_source is recs
+
+    # one work item per STRUCT: the kernel touches all 8 of its bytes and
+    # the epw flag makes transfers move byte ranges while compute ranges
+    # count structs — split across 2 devices
+    src = """
+    __kernel void touch(__global uchar* p) {
+        int i = get_global_id(0);
+        for (int k = 0; k < 8; k++) {
+            p[i*8 + k] = p[i*8 + k];
+        }
+    }"""
+    cr = NumberCruncher(platforms().cpus().subset(2), src)
+    try:
+        wrapped2 = ClArray.wrap_structs(recs, name="r2", partial_read=True)
+        wrapped2.compute(cr, 31, "touch", 256, 64)
+        np.testing.assert_array_equal(recs["a"], np.arange(256, dtype=np.float32))
+        np.testing.assert_array_equal(recs["b"], np.arange(256))
+    finally:
+        cr.dispose()
+
+    # zero-copy aliasing: mutating the view mutates the structs
+    wrapped.host()[0:4] = np.frombuffer(np.float32(99.0).tobytes(), np.uint8)
+    assert recs["a"][0] == 99.0
+
+
+def test_device_partition_lanes():
+    """Device fission analogue (reference: createDeviceAsPartition,
+    ClDevice.cs:85-95): one chip split into N scheduler lanes; the range
+    splits across lanes and results stay exact."""
+    import numpy as np
+
+    from cekirdekler_tpu import ClArray
+    from cekirdekler_tpu.core.cruncher import NumberCruncher
+    from cekirdekler_tpu.hardware import platforms
+
+    dev = platforms().cpus()[0]
+    parts = dev.as_partitions(4)
+    assert len(parts) == 4
+    assert all(p.is_partition for p in parts)
+    assert len({p.name for p in parts}) == 4
+    # concat dedup must keep all four lanes
+    assert len(parts + parts) == 4
+
+    src = """
+    __kernel void twice(__global float* x) {
+        int i = get_global_id(0);
+        x[i] = x[i] * 2.0f;
+    }"""
+    cr = NumberCruncher(parts, src)
+    try:
+        x = ClArray(np.arange(1024, dtype=np.float32), name="x", partial_read=True)
+        x.compute(cr, 41, "twice", 1024, 64)
+        np.testing.assert_allclose(np.asarray(x), np.arange(1024) * 2.0)
+        r = cr.ranges_of(41)
+        assert len(r) == 4 and sum(r) == 1024
+    finally:
+        cr.dispose()

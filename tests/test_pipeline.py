@@ -156,3 +156,90 @@ def test_transition_requires_binding_on_next_stage():
     s2 = _stage(S2, "timesTwo")
     with pytest.raises(ComputeValidationError, match="not bound"):
         DevicePipeline.make([s1, s2], _cpus(1)[0])
+
+
+def test_multi_chip_stage_owns_its_cruncher():
+    """A stage may span multiple chips via a stage-local Cores (reference:
+    per-stage cruncher over a ClDevices set, ClPipeline.cs:225-285): the
+    stage's range splits across ITS devices while the pipeline still flows
+    stage-to-stage."""
+    s1 = PipelineStage(S1, "addOne", global_range=N, local_range=64,
+                       devices=_cpus(3))
+    s1.add_input(ClArray(N, np.float32, partial_read=True))
+    s1.add_output(ClArray(N, np.float32))
+    s2 = _stage(S2, "timesTwo")
+
+    pipe = ClPipeline.make([s1, s2], list(_cpus(1)))
+    assert s1._cores is not None and s1._cores.num_devices == 3
+    assert s2._cores is None
+    result = np.zeros(N, np.float32)
+    outputs = []
+    for g in range(6):
+        ready = pipe.push(np.full(N, float(g), np.float32), result)
+        if ready:
+            outputs.append(result.copy())
+    for j, out in enumerate(outputs):
+        np.testing.assert_array_equal(out, np.full(N, (j + 1.0) * 2.0, np.float32))
+    # the multi-chip stage really split its range
+    r = s1._cores.ranges_of(1)
+    assert len(r) == 3 and sum(r) == N
+    pipe.dispose()
+
+
+def test_multi_chip_final_stage_results():
+    """Multi-chip stage as the LAST stage: its host-published outputs feed
+    push(results=...) correctly."""
+    s1 = _stage(S1, "addOne")
+    s2 = PipelineStage(S2, "timesTwo", global_range=N, local_range=64,
+                       devices=_cpus(2))
+    s2.add_input(ClArray(N, np.float32, partial_read=True))
+    s2.add_output(ClArray(N, np.float32))
+
+    pipe = ClPipeline.make([s1, s2], list(_cpus(1)))
+    result = np.zeros(N, np.float32)
+    got = []
+    for g in range(5):
+        if pipe.push(np.full(N, float(g), np.float32), result):
+            got.append(result.copy())
+    for j, out in enumerate(got):
+        np.testing.assert_array_equal(out, np.full(N, (j + 1.0) * 2.0, np.float32))
+    pipe.dispose()
+
+
+def test_multi_to_multi_stage_handoff_is_snapshot():
+    """Both stages multi-chip: the generation handed to stage B must be a
+    SNAPSHOT of stage A's output, not a live alias of A's host buffer
+    (A's next-generation compute overwrites it concurrently)."""
+    sA = PipelineStage(S1, "addOne", global_range=N, local_range=64,
+                       devices=_cpus(2))
+    sA.add_input(ClArray(N, np.float32, partial_read=True))
+    sA.add_output(ClArray(N, np.float32))
+    sB = PipelineStage(S2, "timesTwo", global_range=N, local_range=64,
+                       devices=_cpus(2))
+    sB.add_input(ClArray(N, np.float32, partial_read=True))
+    sB.add_output(ClArray(N, np.float32))
+
+    pipe = ClPipeline.make([sA, sB], [])
+    result = np.zeros(N, np.float32)
+    got = []
+    for g in range(6):
+        if pipe.push(np.full(N, float(g), np.float32), result):
+            got.append(result.copy())
+    for j, out in enumerate(got):
+        np.testing.assert_array_equal(out, np.full(N, (j + 1.0) * 2.0, np.float32))
+    pipe.dispose()
+
+
+def test_stage_with_empty_devices_treated_as_unassigned():
+    """devices=[] must mean 'unassigned' consistently — the stage draws
+    from the pipeline's device list instead of raising StopIteration."""
+    s1 = PipelineStage(S1, "addOne", global_range=N, local_range=64, devices=[])
+    s1.add_input(ClArray(N, np.float32))
+    s1.add_output(ClArray(N, np.float32))
+    pipe = ClPipeline.make([s1], list(_cpus(1)))
+    assert s1._cores is None and s1.device is not None
+    result = np.zeros(N, np.float32)
+    for g in range(2):
+        pipe.push(np.full(N, float(g), np.float32), result)
+    np.testing.assert_array_equal(result, np.full(N, 2.0, np.float32))
+    pipe.dispose()
