@@ -178,6 +178,11 @@ class _Ctx:
         self.gid = KVal(offset + idx, "int", affine=(1, 0))
         # padded-view cache for shifted slice loads: name -> {const: padded}
         self._pad_cache: dict[str, dict[int, Any]] = {}
+        # remainder stack (statements that can still run after the current
+        # one, per enclosing block) — liveness input for free-run
+        # elimination; and the active (mask, names) free-run grant
+        self._after_stack: list[list] = []
+        self._freerun: tuple | None = None
 
     def broadcast_scalar(self, val, dtype):
         """Materialize a scalar as a full work-item vector of this ctx's
@@ -581,8 +586,16 @@ def _store(ctx: _Ctx, node: Index, val: KVal) -> None:
 
 
 def _exec_block(ctx: _Ctx, stmts: list) -> None:
-    for s in stmts:
-        _exec(ctx, s)
+    # remainder stack: lets a loop see every statement that can still run
+    # after it returns (this block's tail + all enclosing blocks' tails) —
+    # the liveness input for free-run predication elimination (_exec_loop)
+    stack = ctx._after_stack
+    for i, s in enumerate(stmts):
+        stack.append(stmts[i + 1 :])
+        try:
+            _exec(ctx, s)
+        finally:
+            stack.pop()
 
 
 def _exec(ctx: _Ctx, node) -> None:
@@ -612,8 +625,15 @@ def _exec(ctx: _Ctx, node) -> None:
         _exec_loop(ctx, node)
         return
     if isinstance(node, DoWhile):
-        # body once unconditionally (under the active mask), then the loop
-        _exec_block(ctx, node.body)
+        # body once unconditionally (under the active mask), then the loop.
+        # The first pass counts as "inside a loop" for nested loops: the
+        # body re-runs via the While, so an inner loop's free-run liveness
+        # cannot be derived from the remainder stack alone
+        ctx.info["in_loop"] = ctx.info.get("in_loop", 0) + 1
+        try:
+            _exec_block(ctx, node.body)
+        finally:
+            ctx.info["in_loop"] -= 1
         _exec_loop(ctx, While(cond=node.cond, body=node.body, line=node.line))
         return
     if isinstance(node, Return):
@@ -637,6 +657,14 @@ def _assign(ctx: _Ctx, target, op: str, value_expr) -> None:
             old = ctx.env[name]
             new = _as_dtype(rhs, old.ctype)  # assignment keeps the declared C type
             m = ctx.active_mask()
+            fr = ctx._freerun
+            if (
+                m is not None
+                and fr is not None
+                and m is fr[0]
+                and name in fr[1]
+            ):
+                m = None  # free-run: dead lanes' values are never observed
             if m is not None:
                 ov, nv = _num(old), _num(new)
                 merged = jnp.where(m, nv, ov)
@@ -684,7 +712,14 @@ def _exec_if(ctx: _Ctx, node: If) -> None:
     else_mask = jnp.logical_not(cvec) if outer_mask is None else jnp.logical_and(outer_mask, jnp.logical_not(cvec))
 
     ctx.mask = then_mask
-    _exec_block(ctx, node.then)
+    # the else branch runs AFTER the then branch in trace order: for a loop
+    # inside `then`, reads in `other` are still pending — they must count
+    # as "read after the loop" for free-run liveness
+    ctx._after_stack.append(node.other)
+    try:
+        _exec_block(ctx, node.then)
+    finally:
+        ctx._after_stack.pop()
     if node.other:
         ctx.mask = else_mask
         _exec_block(ctx, node.other)
@@ -707,6 +742,22 @@ def _exec_loop(ctx: _Ctx, node) -> None:
     carried_bufs = sorted(_stored_bufs(body) & set(ctx.bufs.keys()))
 
     outer_mask = ctx.active_mask()
+
+    # Free-run predication elimination: a carried variable that is never
+    # read AFTER the loop needs no per-lane where-freeze — once a lane's
+    # active bit clears it can never re-set (new_active ANDs the old), so a
+    # dead lane's free-running value only feeds the cond (ANDed away) and
+    # masked stores.  This is the optimization the hand-written mandelbrot
+    # kernel applies manually (ops/mandelbrot.py: escaped orbits free-run
+    # to inf) and removes the dominant per-iteration where chain.  Only at
+    # top level (in_loop == 0): inside an enclosing loop the body re-runs,
+    # so "after" cannot be derived from the remainder stack alone.
+    freerun: set[str] = set()
+    if not ctx.info.get("in_loop", 0):
+        read_later: set[str] = set()
+        for rest in ctx._after_stack:
+            _vars_read(rest, read_later)
+        freerun = {v for v in carried_vars if v not in read_later}
 
     # broadcast carried locals to the work-item shape so loop-carry shapes
     # are stable (broadcast_scalar: the Pallas subclass forces a computed
@@ -763,6 +814,7 @@ def _exec_loop(ctx: _Ctx, node) -> None:
         saved_env, saved_bufs, saved_mask = dict(ctx.env), dict(ctx.bufs), ctx.mask
         saved_stored = set(ctx.stored)
         saved_rm = ctx.return_mask
+        saved_fr = ctx._freerun
         ctx.info["in_loop"] = ctx.info.get("in_loop", 0) + 1
         try:
             for k in carried_vars:
@@ -772,6 +824,9 @@ def _exec_loop(ctx: _Ctx, node) -> None:
             ctx._pad_cache.clear()  # buffers swapped to loop tracers
             ctx.mask = active
             ctx.return_mask = None
+            # assignments whose mask is EXACTLY this loop's active mask may
+            # skip the where-merge for free-run variables (see above)
+            ctx._freerun = (active, freerun) if freerun else None
             env_keys_before = set(ctx.env.keys())
             _exec_block(ctx, body)
             if ctx.return_mask is not None:
@@ -791,6 +846,7 @@ def _exec_loop(ctx: _Ctx, node) -> None:
             ctx.env, ctx.bufs, ctx.mask = saved_env, saved_bufs, saved_mask
             ctx.stored = saved_stored | ctx.stored
             ctx.return_mask = saved_rm
+            ctx._freerun = saved_fr
 
     active_f, env_f, bufs_f = lax.while_loop(
         cond_fun, body_fun, (to_carry_mask(active0), init_env, init_bufs)
@@ -801,6 +857,28 @@ def _exec_loop(ctx: _Ctx, node) -> None:
     for k in carried_bufs:
         ctx.bufs[k] = bufs_f[k]
         ctx.stored.add(k)
+
+
+def _vars_read(node, out: set[str] | None = None) -> set[str]:
+    """Every variable NAME referenced anywhere under ``node`` (statements,
+    expressions, conditions, indices).  Conservative liveness input for
+    free-run elimination: a name in here might be read."""
+    if out is None:
+        out = set()
+    if isinstance(node, Var):
+        out.add(node.name)
+        return out
+    if isinstance(node, _Lit):
+        return out
+    if isinstance(node, (list, tuple)):
+        for x in node:
+            _vars_read(x, out)
+        return out
+    if hasattr(node, "__dict__"):
+        for v in vars(node).values():
+            if isinstance(v, (list, tuple)) or hasattr(v, "__dict__"):
+                _vars_read(v, out)
+    return out
 
 
 def _assigned_vars(stmts: list) -> set[str]:

@@ -489,3 +489,79 @@ def test_mixed_program():
     f2, _ = prog.launcher("pyk", 8, 4, 8)
     x = jnp.ones(8, jnp.float32)
     np.testing.assert_allclose(np.asarray(f2(0, (f1(0, (x,))[0],))[0]), 3.0)
+
+
+def test_freerun_loop_var_read_in_else_branch():
+    """Free-run elimination regression: a loop-carried var assigned inside
+    a then-branch loop but read in the ELSE branch must stay where-merged —
+    else-branch lanes keep their original value."""
+    import numpy as np
+
+    from cekirdekler_tpu import ClArray
+    from cekirdekler_tpu.core.cruncher import NumberCruncher
+    from cekirdekler_tpu.hardware import platforms
+
+    src = """
+    __kernel void k(__global float* c, __global float* out, __global float* xs) {
+        int gid = get_global_id(0);
+        float x = xs[gid];
+        int i = 0;
+        if (c[gid] > 0.0f) {
+            while (i < 3) {
+                x = x + 1.0f;
+                i = i + 1;
+                out[gid] = x;
+            }
+        } else {
+            out[gid] = x;
+        }
+    }"""
+    cr = NumberCruncher(platforms().cpus().subset(1), src)
+    try:
+        c = ClArray(np.array([1, -5, 2, -7] * 16, np.float32), name="c")
+        xs = ClArray(np.array([1, -5, 2, -7] * 16, np.float32), name="xs")
+        out = ClArray(64, np.float32, name="out")
+        c.next_param(out, xs).compute(cr, 1, "k", 64, 16)
+        want = np.where(
+            np.array([1, -5, 2, -7] * 16) > 0,
+            np.array([1, -5, 2, -7] * 16, np.float32) + 3.0,
+            np.array([1, -5, 2, -7] * 16, np.float32),
+        )
+        np.testing.assert_allclose(np.asarray(out), want)
+    finally:
+        cr.dispose()
+
+
+def test_freerun_inner_loop_in_do_while_body():
+    """Free-run elimination regression: an inner loop inside a do-while's
+    first (unconditional) body pass must NOT free-run — the body re-runs
+    and reads the variable at its top."""
+    import numpy as np
+
+    from cekirdekler_tpu import ClArray
+    from cekirdekler_tpu.core.cruncher import NumberCruncher
+    from cekirdekler_tpu.hardware import platforms
+
+    src = """
+    __kernel void k(__global float* out) {
+        int gid = get_global_id(0);
+        float x = 0.0f;
+        int n = 0;
+        do {
+            out[gid] = x;
+            int i = 0;
+            while (i < gid) {
+                x = x + 1.0f;
+                i = i + 1;
+            }
+            n = n + 1;
+        } while (n < 2);
+    }"""
+    cr = NumberCruncher(platforms().cpus().subset(1), src)
+    try:
+        out = ClArray(4, np.float32, name="out")
+        out.compute(cr, 1, "k", 4, 2)
+        # second body pass records x after ONE inner-loop run: x = gid
+        np.testing.assert_allclose(np.asarray(out), [0.0, 1.0, 2.0, 3.0])
+    finally:
+        cr.dispose()
