@@ -231,7 +231,7 @@ def main() -> None:
     # that is the product's core claim) — same readback policy.
     cg = run_mandelbrot(
         devs.subset(1), width=width, height=height, max_iter=max_iter,
-        iters=8, warmup=2, use_pallas=False, readback="final", sync_every=8,
+        iters=32, warmup=4, use_pallas=False, readback="final", sync_every=16,
     )
 
     # Device-timeline evidence for the enqueue window (r2 #3a).

@@ -44,7 +44,7 @@ class TransformerConfig:
     max_seq: int = 2048
     dtype: Any = jnp.bfloat16          # activation/compute dtype (MXU-native)
     param_dtype: Any = jnp.float32
-    attention: str = "dense"            # "dense" | "ring" | "ulysses"
+    attention: str = "dense"            # "dense" | "flash" | "ring" | "ulysses"
     remat: bool = False
     sp_axis: str = "sp"
     # mixture of experts: n_experts > 0 turns every ``moe_every``-th block's
@@ -186,6 +186,19 @@ class Transformer:
                 out_specs=spec,
             )
             return fn(q, k, v)
+        if c.attention == "flash" and mesh is None:
+            # single-chip Pallas hot op (ops/flash_attention.py): tiled
+            # stable-softmax, O(block²) attention memory, differentiable.
+            # Under a mesh this falls through to the GSPMD-partitionable
+            # dense path instead — pallas_call cannot be auto-partitioned,
+            # and the sequence/tensor-parallel forms are ring/ulysses.
+            import math as _math
+
+            from ..ops.flash_attention import flash_attention
+
+            T = q.shape[1]
+            blk = _math.gcd(T, 128)  # largest power-of-two block dividing T
+            return flash_attention(q, k, v, True, blk, blk)
         return attention_reference(q, k, v, causal=True)
 
     def _block(self, params: dict, x, mesh: Mesh | None):
