@@ -192,13 +192,11 @@ class Transformer:
             # Under a mesh this falls through to the GSPMD-partitionable
             # dense path instead — pallas_call cannot be auto-partitioned,
             # and the sequence/tensor-parallel forms are ring/ulysses.
-            import math as _math
+            from ..ops.flash_attention import auto_block, flash_attention
 
-            from ..ops.flash_attention import flash_attention
-
-            T = q.shape[1]
-            blk = _math.gcd(T, 128)  # largest power-of-two block dividing T
-            return flash_attention(q, k, v, True, blk, blk)
+            blk = auto_block(q.shape[1])
+            if blk is not None:  # degenerate tiling → dense is faster
+                return flash_attention(q, k, v, True, blk, blk)
         return attention_reference(q, k, v, causal=True)
 
     def _block(self, params: dict, x, mesh: Mesh | None):

@@ -32,9 +32,18 @@ import jax.numpy as jnp
 from jax import lax
 from jax.experimental import pallas as pl
 
-__all__ = ["flash_attention"]
+__all__ = ["flash_attention", "auto_block"]
 
 _NEG = -1e30  # finite "-inf": exp(_NEG - m) == 0 without nan hazards
+
+
+def auto_block(T: int, target: int = 128, floor: int = 8) -> int | None:
+    """Largest power-of-two block ≤ ``target`` dividing ``T``, or None when
+    only degenerate tiles (< ``floor``) divide it — callers should fall
+    back to dense attention then (a (1, D)-tile grid of T² steps is far
+    slower than the dense einsum it replaces)."""
+    blk = math.gcd(T, target)
+    return blk if blk >= floor else None
 
 
 def _fa_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
@@ -124,6 +133,16 @@ def _flash_forward(q, k, v, causal, block_q, block_k, interpret, precision):
     )
     from jax.experimental.pallas import tpu as pltpu
 
+    # under shard_map the output must declare how it varies over mesh axes
+    # (vma): the union of ALL operands' — a replicated q attending sharded
+    # k/v still produces per-shard-varying output
+    try:
+        vma = frozenset(
+            jax.typeof(q3).vma | jax.typeof(k3).vma | jax.typeof(v3).vma
+        )
+        out_shape = jax.ShapeDtypeStruct((B * H, Tq, D), q.dtype, vma=vma)
+    except (TypeError, AttributeError):
+        out_shape = jax.ShapeDtypeStruct((B * H, Tq, D), q.dtype)
     out = pl.pallas_call(
         kernel,
         grid=(B * H, Tq // bq, n_kb),
@@ -133,7 +152,7 @@ def _flash_forward(q, k, v, causal, block_q, block_k, interpret, precision):
             pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
         ],
         out_specs=pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((B * H, Tq, D), q.dtype),
+        out_shape=out_shape,
         scratch_shapes=[
             pltpu.VMEM((bq, 128), jnp.float32),  # running max (col 0)
             pltpu.VMEM((bq, 128), jnp.float32),  # running denominator
@@ -144,12 +163,12 @@ def _flash_forward(q, k, v, causal, block_q, block_k, interpret, precision):
     return out.reshape(B, H, Tq, D).transpose(0, 2, 1, 3)
 
 
-def _dense_f32(q, k, v, causal):
+def _dense_f32(q, k, v, causal, prec=lax.Precision.HIGHEST):
     """Score/probability recompute used by the backward (plain XLA)."""
     scale = 1.0 / math.sqrt(q.shape[-1])
     s = jnp.einsum(
         "bqhd,bkhd->bhqk", q.astype(jnp.float32) * scale, k.astype(jnp.float32),
-        precision=lax.Precision.HIGHEST,
+        precision=prec,
     )
     if causal:
         Tq, Tk = q.shape[1], k.shape[1]
@@ -186,16 +205,22 @@ def _fa_fwd(q, k, v, causal, block_q, block_k, interpret, precision):
 
 def _fa_bwd(causal, block_q, block_k, interpret, precision, res, do):
     q, k, v = res
-    p, scale = _dense_f32(q, k, v, causal)          # [B,H,Tq,Tk]
+    # honor the caller's precision trade in the backward too — it is the
+    # dominant training cost, so "default" (bf16 MXU passes) must actually
+    # apply here, not just in the forward kernel
+    prec = (
+        lax.Precision.HIGHEST if precision == "highest" else lax.Precision.DEFAULT
+    )
+    p, scale = _dense_f32(q, k, v, causal, prec)    # [B,H,Tq,Tk]
     do32 = do.astype(jnp.float32)
-    dv = jnp.einsum("bhqk,bqhd->bkhd", p, do32, precision=lax.Precision.HIGHEST)
+    dv = jnp.einsum("bhqk,bqhd->bkhd", p, do32, precision=prec)
     dp = jnp.einsum("bqhd,bkhd->bhqk", do32, v.astype(jnp.float32),
-                    precision=lax.Precision.HIGHEST)
+                    precision=prec)
     ds = p * (dp - (dp * p).sum(axis=-1, keepdims=True))
     dq = scale * jnp.einsum("bhqk,bkhd->bqhd", ds, k.astype(jnp.float32),
-                            precision=lax.Precision.HIGHEST)
+                            precision=prec)
     dk = scale * jnp.einsum("bhqk,bqhd->bkhd", ds, q.astype(jnp.float32),
-                            precision=lax.Precision.HIGHEST)
+                            precision=prec)
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 

@@ -87,7 +87,7 @@ def mandelbrot_pallas(
     width: int,
     max_iter: int,
     offset=0,
-    block_rows: int = 256,
+    block_rows: int = 512,  # device-timeline sweep on v5e: 512 > 256 > 128
     interpret: bool | None = None,
 ):
     """Escape counts (f32) for flat pixels [offset, offset+n).

@@ -124,14 +124,30 @@ def ring_attention(q, k, v, axis: str, causal: bool = False):
     return out.transpose(0, 2, 1, 3).astype(q.dtype)
 
 
-def ulysses_attention(q, k, v, axis: str, causal: bool = False):
+def ulysses_attention(q, k, v, axis: str, causal: bool = False,
+                      flash: bool = False):
     """Ulysses (all-to-all) sequence parallelism over ``axis`` (call inside
-    shard_map).  Local shapes [B, T/n, H, D] with H % n == 0."""
+    shard_map).  Local shapes [B, T/n, H, D] with H % n == 0.
+
+    ``flash=True`` runs the per-chip full-sequence attention through the
+    Pallas flash kernel (ops/flash_attention.py) instead of the dense
+    einsum — after the all-to-all each chip holds an ordinary aligned
+    causal attention problem, exactly the flash kernel's contract, so the
+    long-context memory win (no [T, T] score materialization) composes
+    directly with the sequence parallelism."""
     # seq-sharded → head-sharded: each chip gets the FULL sequence of H/n heads
     q2 = all_to_all(q, axis, split_axis=2, concat_axis=1)
     k2 = all_to_all(k, axis, split_axis=2, concat_axis=1)
     v2 = all_to_all(v, axis, split_axis=2, concat_axis=1)
-    o2 = attention_reference(q2, k2, v2, causal=causal)
+    if flash:
+        from ..ops.flash_attention import auto_block, flash_attention
+
+        blk = auto_block(q2.shape[1])
+        flash = blk is not None  # degenerate tiling → dense is faster
+    if flash:
+        o2 = flash_attention(q2, k2, v2, causal, blk, blk)
+    else:
+        o2 = attention_reference(q2, k2, v2, causal=causal)
     # head-sharded → seq-sharded
     return all_to_all(o2, axis, split_axis=1, concat_axis=2)
 
@@ -152,11 +168,20 @@ def ring_attention_sharded(mesh: Mesh, q, k, v, *, axis: str = "sp", causal: boo
     return fn(q, k, v)
 
 
-def ulysses_attention_sharded(mesh: Mesh, q, k, v, *, axis: str = "sp", causal: bool = False):
+def ulysses_attention_sharded(mesh: Mesh, q, k, v, *, axis: str = "sp",
+                              causal: bool = False, flash: bool = False):
+    kw = {}
+    if flash and jax.default_backend() != "tpu":
+        # the Pallas INTERPRETER (CPU rig) cannot propagate varying-axis
+        # metadata through its internal slices — disable the vma assertion
+        # layer there only; compiled TPU pallas declares its output vma
+        # properly and keeps the safety net
+        kw["check_vma"] = False
     fn = shard_map(
-        functools.partial(ulysses_attention, axis=axis, causal=causal),
+        functools.partial(ulysses_attention, axis=axis, causal=causal, flash=flash),
         mesh=mesh,
         in_specs=(_seq_spec(axis),) * 3,
         out_specs=_seq_spec(axis),
+        **kw,
     )
     return fn(q, k, v)

@@ -113,3 +113,34 @@ def test_transformer_flash_non_multiple_seq_len():
     out = model.apply(params, tok)   # T=200: block gcd(200,128)=8
     assert out.shape == (1, 200, 64)
     assert np.isfinite(np.asarray(out)).all()
+
+
+def test_auto_block_degenerate_lengths():
+    from cekirdekler_tpu.ops.flash_attention import auto_block
+
+    assert auto_block(2048) == 128
+    assert auto_block(200) == 8
+    assert auto_block(999) is None   # odd: gcd 1 — degenerate
+    assert auto_block(17) is None
+
+
+def test_transformer_flash_odd_seq_falls_back_to_dense():
+    """Odd sequence lengths must not explode the Pallas grid — the flash
+    config silently uses the dense path and still matches it."""
+    from cekirdekler_tpu.models import Transformer, TransformerConfig
+
+    def build(attn):
+        cfg = TransformerConfig(
+            vocab=64, d_model=32, n_layers=1, n_heads=2, d_ff=64,
+            max_seq=64, dtype=jnp.float32, attention=attn,
+        )
+        return Transformer(cfg)
+
+    tok = jnp.asarray(
+        np.random.default_rng(9).integers(0, 64, (1, 33)), jnp.int32
+    )
+    dense = build("dense")
+    params = dense.init(jax.random.PRNGKey(0))
+    out_d = dense.apply(params, tok)
+    out_f = build("flash").apply(params, tok)
+    np.testing.assert_allclose(np.asarray(out_f), np.asarray(out_d), rtol=1e-6)
