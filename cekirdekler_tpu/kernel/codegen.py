@@ -183,6 +183,9 @@ class _Ctx:
         # elimination; and the active (mask, names) free-run grant
         self._after_stack: list[list] = []
         self._freerun: tuple | None = None
+        # private fixed-size arrays (``float acc[4];``): name -> length;
+        # the env value is a (length, *shape) vector-per-element stack
+        self.private: dict[str, int] = {}
 
     def broadcast_scalar(self, val, dtype):
         """Materialize a scalar as a full work-item vector of this ctx's
@@ -250,6 +253,11 @@ def _eval(ctx: _Ctx, node) -> KVal:
     if isinstance(node, Num):
         return KVal(node.value, node.ctype, affine=(0, node.value) if node.ctype in _INT_TYPES else None)
     if isinstance(node, Var):
+        if node.name in ctx.private:
+            raise KernelLanguageError(
+                f"private array {node.name!r} used without an index",
+                line=node.line,
+            )
         if node.name in ctx.env:
             return ctx.env[node.name]
         raise KernelCompileError(f"undefined variable {node.name!r}", line=node.line)
@@ -515,7 +523,61 @@ def _call(ctx: _Ctx, node: Call) -> KVal:
 # ---------------------------------------------------------------------------
 
 
+def _private_index(ctx: _Ctx, node: Index, k: int):
+    """Evaluate a private-array index: (const | per-lane vector, clamped)."""
+    idx = _eval(ctx, node.index)
+    if idx.ctype not in _INT_TYPES:
+        raise KernelLanguageError("array index must be an integer", line=node.line)
+    c = _const_int(idx)
+    if c is not None:
+        if not 0 <= c < k:
+            raise KernelCompileError(
+                f"private array index {c} out of bounds [0, {k})", line=node.line
+            )
+        return c
+    iv = _num(_as_dtype(idx, "int"))
+    if not hasattr(iv, "ndim") or iv.ndim == 0:
+        iv = jnp.full(ctx.shape, iv, dtype=jnp.int32)
+    return jnp.clip(iv, 0, k - 1)
+
+
+def _private_load(ctx: _Ctx, node: Index) -> KVal:
+    k = ctx.private[node.base]
+    val = ctx.env[node.base]
+    ix = _private_index(ctx, node, k)
+    if isinstance(ix, int):
+        return KVal(val.value[ix], val.ctype)
+    return KVal(jnp.take_along_axis(val.value, ix[None], axis=0)[0], val.ctype)
+
+
+def _private_store(ctx: _Ctx, node: Index, v: KVal) -> None:
+    k = ctx.private[node.base]
+    cur = ctx.env[node.base]
+    payload = _num(_as_dtype(v, cur.ctype))
+    if not hasattr(payload, "ndim") or payload.ndim == 0:
+        payload = ctx.broadcast_scalar(payload, ctype_to_dtype(cur.ctype))
+    m = ctx.active_mask()
+    ix = _private_index(ctx, node, k)
+    if isinstance(ix, int):
+        row = cur.value[ix]
+        new_row = payload if m is None else jnp.where(m, payload, row)
+        ctx.env[node.base] = KVal(cur.value.at[ix].set(new_row), cur.ctype)
+        return
+    # per-lane dynamic element: each lane updates its own (index, lane) cell
+    gathered = jnp.take_along_axis(cur.value, ix[None], axis=0)[0]
+    new_vals = payload if m is None else jnp.where(m, payload, gathered)
+    ctx.env[node.base] = KVal(_scatter_lanes(cur.value, ix, new_vals), cur.ctype)
+
+
+def _scatter_lanes(stack, ix, vals):
+    """stack[(ix[lane], lane)] = vals[lane] for every lane position."""
+    lanes = jnp.indices(stack.shape[1:])
+    return stack.at[(ix,) + tuple(lanes)].set(vals)
+
+
 def _load(ctx: _Ctx, node: Index) -> KVal:
+    if node.base in ctx.private:
+        return _private_load(ctx, node)
     if node.base not in ctx.bufs:
         raise KernelCompileError(f"{node.base!r} is not an array parameter", line=node.line)
     buf = ctx.bufs[node.base]
@@ -540,6 +602,9 @@ def _load(ctx: _Ctx, node: Index) -> KVal:
 
 
 def _store(ctx: _Ctx, node: Index, val: KVal) -> None:
+    if node.base in ctx.private:
+        _private_store(ctx, node, val)
+        return
     if node.base not in ctx.bufs:
         raise KernelCompileError(f"{node.base!r} is not an array parameter", line=node.line)
     buf = ctx.bufs[node.base]
@@ -601,6 +666,21 @@ def _exec_block(ctx: _Ctx, stmts: list) -> None:
 def _exec(ctx: _Ctx, node) -> None:
     if isinstance(node, Decl):
         for name, init in node.names:
+            if name in node.arrays:
+                if ctx.pallas:
+                    from .pallas_backend import PallasUnsupported
+
+                    raise PallasUnsupported(
+                        f"private array {name!r} (Pallas tile path has no "
+                        "per-item scratch stacking; XLA lowering handles it)"
+                    )
+                k = node.arrays[name]
+                ctx.private[name] = k
+                ctx.env[name] = KVal(
+                    jnp.zeros((k,) + ctx.shape, ctype_to_dtype(node.ctype)),
+                    node.ctype,
+                )
+                continue
             if init is not None:
                 v = _as_dtype(_eval(ctx, init), node.ctype)
             else:
@@ -653,6 +733,11 @@ def _assign(ctx: _Ctx, target, op: str, value_expr) -> None:
         rhs = _binop(ctx, BinOp(op=base_op, left=_Lit(cur), right=_Lit(rhs), line=getattr(target, "line", 0)))
     if isinstance(target, Var):
         name = target.name
+        if name in ctx.private:
+            raise KernelLanguageError(
+                f"cannot assign to private array {name!r} as a whole; "
+                "assign elements", line=getattr(target, "line", 0),
+            )
         if name in ctx.env:
             old = ctx.env[name]
             new = _as_dtype(rhs, old.ctype)  # assignment keeps the declared C type
@@ -837,8 +922,12 @@ def _exec_loop(ctx: _Ctx, node) -> None:
             new_env = {k: _num(ctx.env[k]) for k in carried_vars}
             new_bufs = {k: ctx.bufs[k] for k in carried_bufs}
             # drop loop-local declarations so carry structure stays stable
+            # (private-array registrations scope out with their env entry,
+            # else a loop-local array would shadow a same-named buffer
+            # param after the loop)
             for k in set(ctx.env.keys()) - env_keys_before:
                 del ctx.env[k]
+                ctx.private.pop(k, None)
             new_active = jnp.logical_and(active, eval_cond(new_env, new_bufs))
             return (to_carry_mask(new_active), new_env, new_bufs)
         finally:
@@ -868,6 +957,11 @@ def _vars_read(node, out: set[str] | None = None) -> set[str]:
     if isinstance(node, Var):
         out.add(node.name)
         return out
+    if isinstance(node, Index):
+        # base is a plain string (buffer or private array) — count it
+        out.add(node.base)
+        _vars_read(node.index, out)
+        return out
     if isinstance(node, _Lit):
         return out
     if isinstance(node, (list, tuple)):
@@ -889,8 +983,14 @@ def _assigned_vars(stmts: list) -> set[str]:
             out.update(n for n, _ in s.names)
         elif isinstance(s, Assign) and isinstance(s.target, Var):
             out.add(s.target.name)
+        elif isinstance(s, Assign) and isinstance(s.target, Index):
+            # element store: carries the whole private array through loops
+            # (buffer bases are filtered out by the env intersection)
+            out.add(s.target.base)
         elif isinstance(s, CrementStmt) and isinstance(s.target, Var):
             out.add(s.target.name)
+        elif isinstance(s, CrementStmt) and isinstance(s.target, Index):
+            out.add(s.target.base)
         elif isinstance(s, If):
             for x in s.then:
                 walk(x)

@@ -185,6 +185,10 @@ class Cast(Node):
 class Decl(Node):
     ctype: str
     names: list[tuple[str, Any | None]]  # (name, init-expr or None)
+    # private fixed-size arrays declared in this statement: name -> length
+    # (``float acc[4];`` — OpenCL __private memory, ClArray.cs kernels use
+    # these for per-work-item scratch)
+    arrays: dict = field(default_factory=dict)
 
 
 @dataclass
@@ -450,18 +454,38 @@ class _Parser:
         if self.cur.text == "*":
             raise KernelLanguageError("local pointer variables are not supported", line=line)
         names: list[tuple[str, Any | None]] = []
+        arrays: dict = {}
         while True:
             name_tok = self.advance()
             if name_tok.kind != "id":
                 raise self.err(f"expected variable name, found {name_tok.text!r}", name_tok.line)
             init = None
-            if self.accept("="):
+            if self.accept("["):
+                size_tok = self.advance()
+                if size_tok.kind != "num" or not size_tok.text.isdigit():
+                    raise KernelLanguageError(
+                        "private array size must be an integer literal",
+                        line=size_tok.line,
+                    )
+                self.expect("]")
+                size = int(size_tok.text)
+                if size <= 0:
+                    raise KernelLanguageError(
+                        "private array size must be positive", line=size_tok.line
+                    )
+                if self.cur.text == "=":
+                    raise KernelLanguageError(
+                        "private array initializers are not supported; assign "
+                        "elements explicitly", line=size_tok.line,
+                    )
+                arrays[name_tok.text] = size
+            elif self.accept("="):
                 init = self.parse_expr()
             names.append((name_tok.text, init))
             if self.accept(";"):
                 break
             self.expect(",")
-        return Decl(ctype=ctype, names=names, line=line)
+        return Decl(ctype=ctype, names=names, arrays=arrays, line=line)
 
     def parse_expr_statement(self):
         """assignment / compound assignment / ++ / -- / bare call"""

@@ -352,13 +352,17 @@ class ClPipeline:
         output→input forwarding.  Same-chip handoff is a free value move;
         cross-chip rides ICI via ``device_put``."""
         def handoff(v, nxt):
+            # a multi-chip producer publishes its LIVE arr.host() buffer,
+            # which its own next-generation compute overwrites concurrently
+            # with the consumer's read — and jax.device_put of a numpy
+            # array may read it lazily, racing the same way.  Snapshot
+            # host-published values for EVERY consumer kind.
+            if isinstance(v, np.ndarray):
+                v = np.array(v)
             if nxt._cores is not None:
                 # multi-chip consumer takes host data (its compute uploads
-                # per-chip range slices from it).  ALWAYS a snapshot: a
-                # multi-chip producer publishes its live arr.host() buffer,
-                # which its own next-generation compute will overwrite
-                # concurrently with the consumer's read
-                return np.array(v)
+                # per-chip range slices from it)
+                return v if isinstance(v, np.ndarray) else np.asarray(v)
             return jax.device_put(v, nxt.device.jax_device)
 
         for st in self.stages[:-1]:

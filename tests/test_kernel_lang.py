@@ -565,3 +565,179 @@ def test_freerun_inner_loop_in_do_while_body():
         np.testing.assert_allclose(np.asarray(out), [0.0, 1.0, 2.0, 3.0])
     finally:
         cr.dispose()
+
+
+def test_private_array_polynomial():
+    """Private fixed-size arrays (``float c[4];``): constant-index stores,
+    loop-variable gathers, and loop carry — evaluated against numpy."""
+    import numpy as np
+
+    from cekirdekler_tpu import ClArray
+    from cekirdekler_tpu.core.cruncher import NumberCruncher
+    from cekirdekler_tpu.hardware import platforms
+
+    src = """
+    __kernel void poly(__global float* x, __global float* out) {
+        int i = get_global_id(0);
+        float c[4];
+        c[0] = 1.0f;
+        c[1] = 2.0f;
+        c[2] = 3.0f;
+        c[3] = 4.0f;
+        float acc = 0.0f;
+        float p = 1.0f;
+        for (int j = 0; j < 4; j++) {
+            acc = acc + c[j] * p;
+            p = p * x[i];
+        }
+        out[i] = acc;
+    }"""
+    cr = NumberCruncher(platforms().cpus().subset(2), src)
+    try:
+        xs = np.linspace(-1, 1, 256).astype(np.float32)
+        x = ClArray(xs.copy(), name="x", partial_read=True)
+        out = ClArray(256, np.float32, name="out")
+        x.next_param(out).compute(cr, 1, "poly", 256, 64)
+        want = 1 + 2 * xs + 3 * xs**2 + 4 * xs**3
+        np.testing.assert_allclose(np.asarray(out), want, rtol=1e-5, atol=1e-6)
+    finally:
+        cr.dispose()
+
+
+def test_private_array_dynamic_store_per_lane():
+    """Per-lane dynamic element stores: each work item writes its own
+    bucket of a private array, then reads it back."""
+    import numpy as np
+
+    from cekirdekler_tpu import ClArray
+    from cekirdekler_tpu.core.cruncher import NumberCruncher
+    from cekirdekler_tpu.hardware import platforms
+
+    src = """
+    __kernel void buck(__global int* sel, __global float* out) {
+        int i = get_global_id(0);
+        float slots[4];
+        int b = sel[i];
+        slots[b] = 10.0f + (float)b;
+        out[i] = slots[b] + slots[0];
+    }"""
+    cr = NumberCruncher(platforms().cpus().subset(2), src)
+    try:
+        sel_np = (np.arange(128) % 4).astype(np.int32)
+        sel = ClArray(sel_np.copy(), name="sel", partial_read=True)
+        out = ClArray(128, np.float32, name="out")
+        sel.next_param(out).compute(cr, 1, "buck", 128, 64)
+        slots0 = np.where(sel_np == 0, 10.0, 0.0)
+        want = (10.0 + sel_np) + slots0
+        np.testing.assert_allclose(np.asarray(out), want)
+    finally:
+        cr.dispose()
+
+
+def test_private_array_in_masked_branch():
+    """Element stores under an if-mask only land for active lanes."""
+    import numpy as np
+
+    from cekirdekler_tpu import ClArray
+    from cekirdekler_tpu.core.cruncher import NumberCruncher
+    from cekirdekler_tpu.hardware import platforms
+
+    src = """
+    __kernel void mk(__global float* x, __global float* out) {
+        int i = get_global_id(0);
+        float t[2];
+        t[0] = -1.0f;
+        if (x[i] > 0.0f) {
+            t[0] = x[i];
+        }
+        out[i] = t[0];
+    }"""
+    cr = NumberCruncher(platforms().cpus().subset(1), src)
+    try:
+        xs = np.array([-2.0, 3.0, -0.5, 7.0] * 16, np.float32)
+        x = ClArray(xs.copy(), name="x")
+        out = ClArray(64, np.float32, name="out")
+        x.next_param(out).compute(cr, 1, "mk", 64, 16)
+        np.testing.assert_allclose(np.asarray(out), np.where(xs > 0, xs, -1.0))
+    finally:
+        cr.dispose()
+
+
+def test_private_array_rejected_by_pallas_subset():
+    from cekirdekler_tpu.kernel import lang
+    from cekirdekler_tpu.kernel.pallas_backend import (
+        PallasUnsupported,
+        build_kernel_fn_pallas,
+    )
+    import pytest as _pytest
+
+    src = """
+    __kernel void p(__global float* o) {
+        int i = get_global_id(0);
+        float t[2];
+        t[0] = 1.0f;
+        o[i] = t[0];
+    }"""
+    kdef = lang.parse_kernels(src)[0]
+    with _pytest.raises(PallasUnsupported):
+        build_kernel_fn_pallas(kdef, 256, 64, 256, interpret=True)
+
+
+def test_private_array_whole_use_rejected():
+    """Using a private array without an index — read or whole-assignment —
+    is a language error, not silent stack corruption."""
+    import numpy as np
+    import pytest as _pytest
+
+    from cekirdekler_tpu import ClArray
+    from cekirdekler_tpu.core.cruncher import NumberCruncher
+    from cekirdekler_tpu.errors import KernelLanguageError
+    from cekirdekler_tpu.hardware import platforms
+
+    for body in ("t = 5.0f;", "out[i] = t;"):
+        src = f"""
+        __kernel void k(__global float* out) {{
+            int i = get_global_id(0);
+            float t[2];
+            t[0] = 1.0f;
+            {body}
+            out[i] = t[0];
+        }}"""
+        cr = NumberCruncher(platforms().cpus().subset(1), src)
+        try:
+            out = ClArray(64, np.float32, name="out")
+            with _pytest.raises(KernelLanguageError):
+                out.compute(cr, 1, "k", 64, 16)
+            cr.reset_errors()
+        finally:
+            cr.dispose()
+
+
+def test_private_array_loop_local_scopes_out():
+    """A loop-local private array must not shadow a same-named buffer
+    parameter after the loop ends."""
+    import numpy as np
+
+    from cekirdekler_tpu import ClArray
+    from cekirdekler_tpu.core.cruncher import NumberCruncher
+    from cekirdekler_tpu.hardware import platforms
+
+    src = """
+    __kernel void k(__global float* t, __global float* out) {
+        int i = get_global_id(0);
+        float acc = 0.0f;
+        for (int j = 0; j < 2; j++) {
+            float t[2];
+            t[0] = (float)j;
+            acc = acc + t[0];
+        }
+        out[i] = acc + t[i];
+    }"""
+    cr = NumberCruncher(platforms().cpus().subset(1), src)
+    try:
+        t = ClArray(np.full(64, 10.0, np.float32), name="t")
+        out = ClArray(64, np.float32, name="out")
+        t.next_param(out).compute(cr, 1, "k", 64, 16)
+        np.testing.assert_allclose(np.asarray(out), 1.0 + 10.0)
+    finally:
+        cr.dispose()
