@@ -287,6 +287,12 @@ def main() -> None:
     hbm_gbps = hbm_stream(devs[0].jax_device)
     hbm_util = hbm_gbps / V5E_HBM_GBPS
 
+    # The reference's flagship numeric workload (Tester.nBody), fused-XLA
+    # fast path, self-checked vs the host O(n^2) reference.
+    from cekirdekler_tpu.workloads import run_nbody
+
+    nb = run_nbody(devs.subset(1), n=8192, iters=6, check=True, use_jnp=True)
+
     # Balancer on the 8-device rig with skewed per-range load (r2 #4).
     rig = balancer_rig_section()
 
@@ -314,6 +320,8 @@ def main() -> None:
         },
         "mean_escape_iters": round(mean_iters, 2),
         "gflops": round(gflops, 1),
+        "nbody_gpairs_per_sec": round(nb["gpairs_per_sec"], 3),
+        "nbody_checked": bool(nb["checked"]),
         "hbm_stream_gbps": round(hbm_gbps, 1),
         "hbm_utilization": round(hbm_util, 3),
         "hbm_measurement_suspect": bool(hbm_util > 1.0),

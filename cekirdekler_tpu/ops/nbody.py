@@ -1,0 +1,89 @@
+"""Direct O(n²) n-body — the reference's flagship numeric workload.
+
+Reference: ``Tester.nBody`` (Tester.cs:7682-7799) is both a correctness
+test (±0.01f vs a host loop) and the micro-benchmark behind the device
+ranking DSL (``devicesWithHighestDirectNbodyPerformance``,
+ClObjectApi.cs:1222-1244).  The kernel-language version
+(workloads.NBODY_SRC) exercises the C-subset gather path; this module is
+the TPU-fast path: the pairwise interaction sum as one fused XLA program —
+broadcasting builds the (chunk, n) distance tile, the VPU does the
+rsqrt/accumulate, and XLA tiles it without a Python-visible loop.
+
+``nbody_jnp_kernel`` plugs that math into the SAME compute()/balancer
+machinery as the C kernel (a ``@kernel`` Python program, like the
+mandelbrot Pallas plug-in, workloads.mandelbrot_pallas_kernel);
+``microbenchmark`` times one step on a specific device for the hardware
+ranking DSL.
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["nbody_accels", "nbody_jnp_kernel", "microbenchmark"]
+
+SOFTENING = 1e-4  # matches NBODY_SRC's +0.0001f
+
+
+def nbody_accels(xi, yi, zi, x, y, z):
+    """Accelerations on bodies (xi, yi, zi) from ALL bodies (x, y, z):
+    fused pairwise O(chunk·n) — (chunk, n) tiles, f32."""
+    dx = x[None, :] - xi[:, None]
+    dy = y[None, :] - yi[:, None]
+    dz = z[None, :] - zi[:, None]
+    r2 = dx * dx + dy * dy + dz * dz + SOFTENING
+    inv = lax.rsqrt(r2) / r2  # 1 / (r2 * sqrt(r2))
+    return (dx * inv).sum(axis=1), (dy * inv).sum(axis=1), (dz * inv).sum(axis=1)
+
+
+def nbody_jnp_kernel():
+    """The n-body velocity update as a :func:`~kernel.registry.kernel`
+    Python program — same signature as workloads.NBODY_SRC's ``nBody``
+    kernel, runnable through the load-balanced compute() path."""
+    from ..kernel.registry import kernel
+
+    @kernel(name="nBody", static_values=True)
+    def nBody(gid, x, y, z, vx, vy, vz, n=0, dt=0.0):
+        chunk = gid.shape[0]
+        off = jnp.asarray(gid[0], jnp.int32)
+        xi = lax.dynamic_slice(x, (off,), (chunk,))
+        yi = lax.dynamic_slice(y, (off,), (chunk,))
+        zi = lax.dynamic_slice(z, (off,), (chunk,))
+        ax, ay, az = nbody_accels(xi, yi, zi, x, y, z)
+
+        def upd(v, a):
+            cur = lax.dynamic_slice(v, (off,), (chunk,))
+            return lax.dynamic_update_slice(v, cur + a * dt, (off,))
+
+        return x, y, z, upd(vx, ax), upd(vy, ay), upd(vz, az)
+
+    return nBody
+
+
+def microbenchmark(device, n: int = 2048, iters: int = 3) -> float:
+    """Seconds per full n-body step on ``device`` (lower = faster) — the
+    ranking metric behind ``Devices.with_highest_nbody_performance``
+    (reference: ClObjectApi.cs:1222-1244 runs Tester.nBody per device)."""
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+    with jax.default_device(device):
+        pos = [
+            jnp.asarray(rng.standard_normal(n), jnp.float32) for _ in range(3)
+        ]
+
+        @jax.jit
+        def step(x, y, z):
+            return nbody_accels(x, y, z, x, y, z)
+
+        out = step(*pos)
+        np.asarray(out[0][:1])  # warm + fence
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = step(*pos)
+        np.asarray(out[0][:1])
+    return (time.perf_counter() - t0) / max(iters, 1)

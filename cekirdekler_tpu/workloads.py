@@ -288,10 +288,15 @@ def run_nbody(
     local_range: int = 256,
     check: bool = True,
     tolerance: float = 0.01,
+    use_jnp: bool = False,
 ) -> dict:
     """Load-balanced n-body velocity updates; self-checks the first step
     against the host O(n^2) reference within ``tolerance`` (the reference's
-    ±0.01f pattern, Tester.cs:7682-7799)."""
+    ±0.01f pattern, Tester.cs:7682-7799).
+
+    ``use_jnp`` swaps the C-subset kernel for the fused-XLA fast path
+    (ops/nbody.py) — same name, same compute()/balancer machinery, the
+    per-j gather loop replaced by one pairwise tile program."""
     from .hardware import all_devices
 
     rng = np.random.default_rng(42)
@@ -307,7 +312,13 @@ def run_nbody(
             np.zeros(n, np.float32), np.zeros(n, np.float32), np.zeros(n, np.float32),
             dt,
         )
-    cr = NumberCruncher(devices or all_devices(), NBODY_SRC)
+    if use_jnp:
+        from .ops.nbody import nbody_jnp_kernel
+
+        source = nbody_jnp_kernel()
+    else:
+        source = NBODY_SRC
+    cr = NumberCruncher(devices or all_devices(), source)
     group = x.next_param(y, z, *vel)
     times: list[float] = []
     try:

@@ -193,3 +193,20 @@ def test_pipelined_not_catastrophically_slower_than_plain():
         assert t_pipe < 3.0 * t_plain + 0.05, (t_pipe, t_plain)
     finally:
         cr.dispose()
+
+
+def test_nbody_jnp_fast_path_matches_host():
+    """The fused-XLA n-body (ops/nbody.py) through the compute path:
+    self-check vs the host O(n^2) reference, multi-device."""
+    from cekirdekler_tpu.workloads import run_nbody
+
+    res = run_nbody(_cpus().subset(2), n=512, iters=2, check=True, use_jnp=True)
+    assert res["checked"] and res["gpairs_per_sec"] > 0
+
+
+def test_nbody_device_ranking_runs():
+    """with_highest_nbody_performance must actually run (regression: the
+    ops.nbody module it imports did not exist)."""
+    devs = _cpus().subset(2)
+    ranked = devs.with_highest_nbody_performance(n=128, iters=1)
+    assert len(ranked) == 2
