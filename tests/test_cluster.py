@@ -178,3 +178,48 @@ def test_probe_finds_live_servers(two_servers):
     )
     assert (s1.host, s1.port) in live and (s2.host, s2.port) in live
     assert ("127.0.0.1", 1) not in live
+
+
+def test_cluster_across_real_processes():
+    """A server in a SEPARATE python process (true serialization + GIL
+    boundary, the reference's actual deployment shape): the cluster
+    computes correctly against it plus the local mainframe."""
+    import os
+    import subprocess
+    import sys
+    import time as _t
+
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    proc = subprocess.Popen(
+        [sys.executable, "-c", (
+            "from cekirdekler_tpu.cluster import CruncherServer\n"
+            "import cekirdekler_tpu as ct, sys, time\n"
+            "s = CruncherServer(devices=ct.all_devices().cpus().subset(2))\n"
+            "print(s.port, flush=True)\n"
+            "time.sleep(120)\n"
+        )],
+        env=env, cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        stdout=subprocess.PIPE, text=True,
+    )
+    try:
+        port = int(proc.stdout.readline().strip())
+        n = 2048
+        x = ClArray(np.arange(n, dtype=np.float32), partial_read=True, read_only=True)
+        y = ClArray(np.ones(n, np.float32), partial_read=True)
+        cluster = ClusterAccelerator([("127.0.0.1", port)], local_devices=_cpus(2))
+        try:
+            cluster.setup_nodes(SRC)
+            for _ in range(2):
+                cluster.compute(["saxpy"], [x, y], compute_id=1,
+                                global_range=n, local_range=64, values=(2.0,))
+            np.testing.assert_allclose(
+                np.asarray(y), 1.0 + 2 * 2.0 * np.arange(n), rtol=1e-6
+            )
+        finally:
+            cluster.dispose()
+    finally:
+        proc.kill()
+        proc.wait(timeout=10)
