@@ -32,7 +32,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.experimental import pallas as pl
 
-__all__ = ["flash_attention", "auto_block"]
+__all__ = ["flash_attention", "flash_attention_parts", "auto_block"]
 
 _NEG = -1e30  # finite "-inf": exp(_NEG - m) == 0 without nan hazards
 
@@ -46,8 +46,8 @@ def auto_block(T: int, target: int = 128, floor: int = 8) -> int | None:
     return blk if blk >= floor else None
 
 
-def _fa_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
-               scale, block_q, block_k, n_kb, causal, precision):
+def _fa_kernel(*refs, scale, block_q, block_k, n_kb, causal, precision,
+               parts=False):
     """One (bh, q-block, k-block) grid step.
 
     The k dimension is the MINOR grid axis: Pallas runs it sequentially per
@@ -55,7 +55,24 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
     buffering — the kernel never holds more than one K/V block in VMEM, so
     sequence length is unbounded).  Running max / denominator / output
     accumulate in VMEM scratch across the k steps; the final k step
-    normalizes into the output block."""
+    normalizes into the output block.
+
+    ``parts=True`` is the ring-attention inner form: two extra SMEM scalars
+    (global position offsets of this chip's Q and the in-flight K/V block,
+    runtime values — the ring rotates them) shift the causal mask, and the
+    kernel emits the UNNORMALIZED accumulator plus running max/denominator
+    so ring steps merge stable-softmax state across chips."""
+    if parts:
+        q_off_ref, k_off_ref = refs[0], refs[1]
+        q_ref, k_ref, v_ref = refs[2:5]
+        o_ref, m_ref, l_ref = refs[5:8]
+        m_scr, l_scr, acc_scr = refs[8:]
+        q_pos0 = q_off_ref[0, 0]
+        k_pos0 = k_off_ref[0, 0]
+    else:
+        q_ref, k_ref, v_ref, o_ref = refs[:4]
+        m_scr, l_scr, acc_scr = refs[4:]
+        q_pos0 = k_pos0 = 0
     qi = pl.program_id(1)
     kj = pl.program_id(2)
 
@@ -65,10 +82,14 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
         l_scr[...] = jnp.zeros_like(l_scr)
         acc_scr[...] = jnp.zeros_like(acc_scr)
 
-    # causal: the last query of block qi attends keys [0, qi*bq + bq);
-    # blocks wholly beyond that are skipped (no FLOPs, the DMA is wasted
-    # but the grid is dense)
-    live = (kj * block_k <= qi * block_q + block_q - 1) if causal else True
+    # causal: the last query of block qi attends keys at global positions
+    # <= its own; blocks wholly beyond that are skipped (no FLOPs, the DMA
+    # is wasted but the grid is dense)
+    live = (
+        (k_pos0 + kj * block_k <= q_pos0 + qi * block_q + block_q - 1)
+        if causal
+        else True
+    )
 
     @pl.when(live)
     def _step():
@@ -80,10 +101,10 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
             preferred_element_type=jnp.float32, precision=precision,
         )                                             # (bq, bk)
         if causal:
-            q_pos = qi * block_q + lax.broadcasted_iota(
+            q_pos = q_pos0 + qi * block_q + lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0
             )
-            k_pos = kj * block_k + lax.broadcasted_iota(
+            k_pos = k_pos0 + kj * block_k + lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1
             )
             s = jnp.where(k_pos <= q_pos, s, _NEG)
@@ -100,9 +121,18 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
 
     @pl.when(kj == n_kb - 1)
     def _finish():
-        o_ref[0] = (
-            acc_scr[...] / jnp.maximum(l_scr[:, 0], 1e-30)[:, None]
-        ).astype(o_ref.dtype)
+        if parts:
+            o_ref[0] = acc_scr[...]
+            m_ref[0] = jnp.broadcast_to(
+                m_scr[:, 0][:, None], m_ref.shape[1:]
+            )
+            l_ref[0] = jnp.broadcast_to(
+                l_scr[:, 0][:, None], l_ref.shape[1:]
+            )
+        else:
+            o_ref[0] = (
+                acc_scr[...] / jnp.maximum(l_scr[:, 0], 1e-30)[:, None]
+            ).astype(o_ref.dtype)
 
 
 @functools.partial(
@@ -161,6 +191,84 @@ def _flash_forward(q, k, v, causal, block_q, block_k, interpret, precision):
         interpret=interpret,
     )(q3, k3, v3)
     return out.reshape(B, H, Tq, D).transpose(0, 2, 1, 3)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("causal", "block_q", "block_k", "interpret", "precision"),
+)
+def flash_attention_parts(
+    q, k, v, q_pos0=0, k_pos0=0, causal=False, block_q=128, block_k=128,
+    interpret=None, precision="highest",
+):
+    """Ring-attention inner: UNNORMALIZED flash accumulation of q against
+    one K/V block with runtime global position offsets for the causal
+    mask.  Returns ``(acc, m, l)`` — acc f32 [B, Tq, H, D], running max
+    and denominator f32 [B, Tq, H] — which ring steps merge with the
+    standard stable-softmax combine (parallel/attention.py).  Forward
+    only (no custom_vjp): training uses the einsum ring path."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    prec = (
+        lax.Precision.HIGHEST if precision == "highest" else lax.Precision.DEFAULT
+    )
+    B, Tq, H, D = q.shape
+    Tk = k.shape[1]
+    scale = 1.0 / math.sqrt(D)
+    bq = min(block_q, Tq)
+    bk = min(block_k, Tk)
+    if Tq % bq or Tk % bk:
+        raise ValueError(
+            f"sequence lengths (Tq={Tq}, Tk={Tk}) must be multiples of the "
+            f"blocks (bq={bq}, bk={bk})"
+        )
+    q3 = q.transpose(0, 2, 1, 3).reshape(B * H, Tq, D)
+    k3 = k.transpose(0, 2, 1, 3).reshape(B * H, Tk, D)
+    v3 = v.transpose(0, 2, 1, 3).reshape(B * H, Tk, D)
+    n_kb = Tk // bk
+    kernel = functools.partial(
+        _fa_kernel, scale=scale, block_q=bq, block_k=bk, n_kb=n_kb,
+        causal=causal, precision=prec, parts=True,
+    )
+    scalar_spec = pl.BlockSpec((1, 1), lambda b, i, j: (0, 0),
+                               memory_space=pltpu.SMEM)
+    tile_q = pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0))
+    tile_k = pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0))
+    tile_ml = pl.BlockSpec((1, bq, 128), lambda b, i, j: (b, i, 0))
+    try:
+        vma = frozenset(
+            jax.typeof(q3).vma | jax.typeof(k3).vma | jax.typeof(v3).vma
+        )
+        sds = functools.partial(jax.ShapeDtypeStruct, vma=vma)
+    except (TypeError, AttributeError):
+        sds = jax.ShapeDtypeStruct
+    acc, m, l = pl.pallas_call(
+        kernel,
+        grid=(B * H, Tq // bq, n_kb),
+        in_specs=[scalar_spec, scalar_spec, tile_q, tile_k, tile_k],
+        out_specs=[tile_q, tile_ml, tile_ml],
+        out_shape=[
+            sds((B * H, Tq, D), jnp.float32),
+            sds((B * H, Tq, 128), jnp.float32),
+            sds((B * H, Tq, 128), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, 128), jnp.float32),
+            pltpu.VMEM((bq, 128), jnp.float32),
+            pltpu.VMEM((bq, D), jnp.float32),
+        ],
+        interpret=interpret,
+    )(
+        jnp.asarray(q_pos0, jnp.int32).reshape(1, 1),
+        jnp.asarray(k_pos0, jnp.int32).reshape(1, 1),
+        q3, k3, v3,
+    )
+    acc = acc.reshape(B, H, Tq, D).transpose(0, 2, 1, 3)
+    m = m[..., 0].reshape(B, H, Tq).transpose(0, 2, 1)
+    l = l[..., 0].reshape(B, H, Tq).transpose(0, 2, 1)
+    return acc, m, l
 
 
 def _dense_f32(q, k, v, causal, prec=lax.Precision.HIGHEST):

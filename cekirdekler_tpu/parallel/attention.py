@@ -85,12 +85,26 @@ def _block_accumulate(o, m, l, s, v_blk):
     return o_new, m_new, l_new
 
 
-def ring_attention(q, k, v, axis: str, causal: bool = False):
+def ring_attention(q, k, v, axis: str, causal: bool = False,
+                   flash: bool = False):
     """Ring attention over the named ``axis`` (call inside shard_map).
 
     Local shapes [B, T/n, H, D]; sequence is sharded contiguously in ring
     order (shard r holds positions [r·Tb, (r+1)·Tb)).
+
+    ``flash=True``: each ring step's block attention runs through the
+    Pallas parts kernel (ops/flash_attention.py:flash_attention_parts,
+    unnormalized accumulator + running max/denominator merged across
+    steps) instead of einsums — FORWARD ONLY (no VJP on the parts kernel;
+    training sticks with the einsum path).
     """
+    if flash:
+        from ..ops.flash_attention import auto_block
+
+        if auto_block(q.shape[1]) is not None and auto_block(k.shape[1]) is not None:
+            return _ring_attention_flash(q, k, v, axis, causal)
+        # degenerate tiling (same convention as the ulysses flash path):
+        # fall through to the einsum ring body
     n = lax.axis_size(axis)
     r = lax.axis_index(axis)
     B, Tq, H, D = q.shape
@@ -122,6 +136,45 @@ def ring_attention(q, k, v, axis: str, causal: bool = False):
     o, m, l, _, _ = lax.fori_loop(0, n, body, (o, m, l, k, v))
     out = o / jnp.maximum(l, 1e-30)[..., None]
     return out.transpose(0, 2, 1, 3).astype(q.dtype)
+
+
+def _ring_attention_flash(q, k, v, axis: str, causal: bool):
+    """Flash-inner ring body: per step the in-flight K/V block feeds the
+    parts kernel with its GLOBAL position offset (the ring rotates
+    blocks, the causal mask follows), and the unnormalized results merge
+    with the standard stable-softmax combine."""
+    from ..ops.flash_attention import auto_block, flash_attention_parts
+
+    n = lax.axis_size(axis)
+    r = lax.axis_index(axis)
+    B, Tq, H, D = q.shape
+    Tb = k.shape[1]
+    bq = auto_block(Tq)
+    bk = auto_block(Tb)  # caller (ring_attention) pre-checked tileability
+    # accumulators derived from q so they inherit its varying-axes set
+    zero = q.astype(jnp.float32) * 0.0               # [B,Tq,H,D]
+    o = zero
+    m = zero[..., 0] - 1e30                          # [B,Tq,H] finite "-inf"
+    l = zero[..., 0]
+    q_pos0 = r * Tq
+
+    def body(i, carry):
+        o, m, l, kc, vc = carry
+        src = (r - i) % n
+        acc, ms, ls = flash_attention_parts(
+            q, kc, vc, q_pos0, src * Tb, causal, bq, bk,
+        )
+        m_new = jnp.maximum(m, ms)
+        a1 = jnp.exp(m - m_new)
+        a2 = jnp.exp(ms - m_new)
+        o = o * a1[..., None] + acc * a2[..., None]
+        l = l * a1 + ls * a2
+        kc = ppermute_ring(kc, axis, 1)
+        vc = ppermute_ring(vc, axis, 1)
+        return o, m_new, l, kc, vc
+
+    o, m, l, _, _ = lax.fori_loop(0, n, body, (o, m, l, k, v))
+    return (o / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)
 
 
 def ulysses_attention(q, k, v, axis: str, causal: bool = False,
@@ -156,14 +209,21 @@ def _seq_spec(axis: str):
     return P(None, axis, None, None)
 
 
-def ring_attention_sharded(mesh: Mesh, q, k, v, *, axis: str = "sp", causal: bool = False):
+def ring_attention_sharded(mesh: Mesh, q, k, v, *, axis: str = "sp",
+                           causal: bool = False, flash: bool = False):
     """shard_map wrapper: q/k/v are global [B,T,H,D] arrays (or will be
     sharded on entry) with T split over ``axis``."""
+    kw = {}
+    if flash and jax.default_backend() != "tpu":
+        # the Pallas INTERPRETER cannot propagate varying-axis metadata
+        # (same workaround as the ulysses wrapper below)
+        kw["check_vma"] = False
     fn = shard_map(
-        functools.partial(ring_attention, axis=axis, causal=causal),
+        functools.partial(ring_attention, axis=axis, causal=causal, flash=flash),
         mesh=mesh,
         in_specs=(_seq_spec(axis),) * 3,
         out_specs=_seq_spec(axis),
+        **kw,
     )
     return fn(q, k, v)
 

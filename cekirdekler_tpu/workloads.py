@@ -99,6 +99,22 @@ __kernel void streamAdd(__global float* a, __global float* b, __global float* c)
 }
 """
 
+# Compute-heavy stream: per-element iteration loop so blob compute time is
+# commensurate with blob transfer time — the regime where the pipeline
+# engines' read/compute/write overlap is actually measurable (on a slow
+# host link, plain streamAdd is ~99% transfer and overlap is unobservable).
+STREAM_HEAVY_SRC = """
+__kernel void streamHeavy(__global float* a, __global float* b, __global float* c,
+                          int iters) {
+    int i = get_global_id(0);
+    float acc = a[i];
+    for (int k = 0; k < iters; k++) {
+        acc = acc * 0.9999999f + b[i] * 0.0000001f;
+    }
+    c[i] = acc;
+}
+"""
+
 
 def mandelbrot_pallas_kernel(interpret: bool | None = None):
     """The mandelbrot workload as a raw-Pallas :class:`PythonKernel` —
@@ -393,18 +409,27 @@ def measure_stream_overlap(
     local_range: int = 256,
     pipeline_type: int | None = None,
     reps: int = 3,
+    heavy_iters: int = 0,
 ) -> dict:
     """Measure the realized read/compute/write overlap fraction of the
     pipelined path on ONE chip (BASELINE.md metric 2; the engineered
     property behind the reference's 3× pipelining claim, Cores.cs:467).
 
-    Method (VERDICT r2 #3 — comparable phases, no clipping): every phase
-    runs ``reps`` times inside ONE fence window; the measured idle fence
-    round trip is subtracted once per window and the remainder divided by
-    ``reps``, so per-phase numbers are transfer/compute time, not fence
-    latency (round-2's isolated phases were fence-dominated, which made the
-    ratio >1 and meaningless).  With per-rep phase times r, c, w and
-    pipelined per-rep total p::
+    ``heavy_iters`` > 0 swaps the plain add for a per-element iteration
+    kernel so blob compute is commensurate with blob transfer — on a slow
+    host link plain streamAdd is ~99% transfer and r/c/w overlap is
+    unobservable regardless of scheduling.
+
+    Method (VERDICT r2 #3 — comparable phases, no clipping): ``reps``
+    INTERLEAVED rounds, each measuring every phase once (idle fence RTT
+    sampled per round and subtracted from fence-terminated phases), and the
+    per-phase MEDIAN across rounds is reported — host-link bandwidth
+    drifts by ~2x over minutes, so separate multi-rep windows per phase
+    let drift masquerade as ±overlap (round-2's isolated phases were
+    additionally fence-dominated, making the ratio >1 and meaningless).
+    ``sample_spread`` reports max per-phase (max-min)/median so the
+    artifact shows how noisy the link was.  With median phase times r, c,
+    w and pipelined total p::
 
         overlap = (r + c + w - p) / (r + c + w - max(r, c, w))
 
@@ -423,7 +448,9 @@ def measure_stream_overlap(
     if pipeline_type is None:
         pipeline_type = PIPELINE_EVENT
     devs = (devices or all_devices()).subset(1)
-    cr = NumberCruncher(devs, STREAM_SRC)
+    kname = "streamHeavy" if heavy_iters else "streamAdd"
+    kvals = (heavy_iters,) if heavy_iters else ()
+    cr = NumberCruncher(devs, STREAM_HEAVY_SRC if heavy_iters else STREAM_SRC)
     w = cr.cores.workers[0]
     a = ClArray(n, np.float32, name="ov_a", partial_read=True, read_only=True)
     b = ClArray(n, np.float32, name="ov_b", partial_read=True, read_only=True)
@@ -447,7 +474,7 @@ def measure_stream_overlap(
         w.ensure_resident(c)
         for k in range(blobs):
             w.launch(
-                cr.program, ["streamAdd"], [a, b, c], (),
+                cr.program, [kname], [a, b, c], kvals,
                 k * blob, blob, local_range, n, local_range,
             )
 
@@ -464,22 +491,20 @@ def measure_stream_overlap(
         for arr in (a, b, c):
             w.invalidate(arr)
         a.next_param(b, c).compute(
-            cr, 7004, "streamAdd", n, local_range,
+            cr, 7004, kname, n, local_range,
             pipeline=True, pipeline_blobs=blobs, pipeline_type=pipeline_type,
+            values=kvals,
         )
 
-    def window(fn, needs_fence: bool, rtt: float) -> float:
-        """Per-rep ms: ``reps`` runs in one window, one fence at the end
-        (if the phase isn't self-joining), idle-fence cost subtracted."""
+    def timed(fn, needs_fence: bool, rtt: float) -> float:
         t0 = time.perf_counter()
-        for _ in range(reps):
-            fn()
+        fn()
         if needs_fence:
             fence()
         total = (time.perf_counter() - t0) * 1000.0
         if needs_fence:
             total -= rtt
-        return max(total, 1e-6) / reps
+        return max(total, 1e-6)
 
     try:
         # warmup: compile + first-touch, and all four paths exercised once
@@ -488,29 +513,58 @@ def measure_stream_overlap(
         fence()
         phase_write()
         phase_pipelined()
-        # idle fence round trip (median of 3)
-        rtts = []
-        for _ in range(3):
+        # INTERLEAVED rounds (VERDICT-honest methodology note: tunnel
+        # bandwidth drifts by 2x over minutes, so measuring each phase in
+        # its own multi-rep window lets drift masquerade as ±overlap;
+        # round-robin sampling keeps every phase's samples seconds apart
+        # and the per-phase MEDIAN cancels the drift)
+        samples: dict[str, list[float]] = {"r": [], "c": [], "w": [], "p": [], "rtt": []}
+        for _ in range(reps):
             t0 = time.perf_counter()
             fence()
-            rtts.append((time.perf_counter() - t0) * 1000.0)
-        rtt = sorted(rtts)[1]
-        t_r = window(phase_read, True, rtt)
-        t_c = window(phase_compute, True, rtt)
-        t_w = window(phase_write, False, rtt)  # joins are the completion
-        t_p = window(phase_pipelined, False, rtt)  # compute() joins D2H
+            rtt = (time.perf_counter() - t0) * 1000.0
+            samples["rtt"].append(rtt)
+            samples["r"].append(timed(phase_read, True, rtt))
+            samples["c"].append(timed(phase_compute, True, rtt))
+            samples["w"].append(timed(phase_write, False, rtt))
+            samples["p"].append(timed(phase_pipelined, False, rtt))
+
+        def med(key: str) -> float:
+            vals = sorted(samples[key])
+            return vals[len(vals) // 2]
+
+        t_r, t_c, t_w, t_p = med("r"), med("c"), med("w"), med("p")
         serial = t_r + t_c + t_w
         ideal = serial - max(t_r, t_c, t_w)
         overlap = (serial - t_p) / ideal if ideal > 1e-9 else 0.0
-        np.testing.assert_allclose(c.host(), a.host() + b.host())
+        spread = max(
+            (max(samples[k]) - min(samples[k])) / max(med(k), 1e-9)
+            for k in ("r", "w", "p")
+        )
+        if heavy_iters:
+            # closed form of acc_{k+1} = acc_k*r + b*s iterated n times
+            # (r, s taken at their f32-rounded values):
+            #   acc_n = a*r^n + b*s*(1 - r^n)/(1 - r)
+            # — the timing numbers are only publishable if the pipelined
+            # path computed the right thing
+            r = float(np.float32(0.9999999))
+            s = float(np.float32(0.0000001))
+            rn = r ** heavy_iters
+            want = a.host() * rn + b.host() * s * (1.0 - rn) / (1.0 - r)
+            np.testing.assert_allclose(
+                np.asarray(c.host(), np.float64), want, rtol=1e-3, atol=1e-3
+            )
+        else:
+            np.testing.assert_allclose(c.host(), a.host() + b.host())
         return {
             "t_read_ms": t_r,
             "t_compute_ms": t_c,
             "t_write_ms": t_w,
             "t_pipelined_ms": t_p,
             "t_serial_ms": serial,
-            "rtt_ms": rtt,
+            "rtt_ms": med("rtt"),
             "overlap_fraction": overlap,  # RAW — see docstring
+            "sample_spread": spread,  # >1 = tunnel drift swamps the signal
             "n": n,
             "blobs": blobs,
             "reps": reps,
