@@ -73,6 +73,25 @@ class NumberCruncher:
             self.cores.flush()  # leaving enqueue mode syncs results to host
 
     @property
+    def enqueue_mode_async_enable(self) -> bool:
+        """Compatibility toggle (reference: enqueueModeAsyncEnable,
+        ClNumberCruncher.cs:114-118 — rotate enqueued work over 16 async
+        queues).  On TPU every dispatch is already an async operation on
+        the chip's stream, so this is always effectively on; the flag is
+        kept for API parity and introspection."""
+        return getattr(self.cores, "_async_enable", True)
+
+    @enqueue_mode_async_enable.setter
+    def enqueue_mode_async_enable(self, v: bool) -> None:
+        self.cores._async_enable = bool(v)
+
+    @property
+    def last_compute_performance_report(self) -> str:
+        """The most recent compute's per-device report (reference:
+        lastComputePerformanceReport, ClNumberCruncher.cs:179-182)."""
+        return self.cores.performance_report()
+
+    @property
     def no_compute_mode(self) -> bool:
         return self.cores.no_compute_mode
 

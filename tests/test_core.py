@@ -486,3 +486,17 @@ def test_dispatch_gate_synchronized_start(devs):
     cr.dispatch_gate = None
     gate.close()
     cr.dispose()
+
+
+def test_facade_compat_toggles(devs):
+    """Reference facade parity: enqueue_mode_async_enable (always-on
+    compatibility flag) and last_compute_performance_report."""
+    cr = NumberCruncher(devs.subset(2), VADD)
+    assert cr.enqueue_mode_async_enable is True
+    cr.enqueue_mode_async_enable = False
+    assert cr.enqueue_mode_async_enable is False
+    a, b, c = make_abc()
+    a.next_param(b).next_param(c).compute(cr, 1, "vadd", 1024, 64)
+    rep = cr.last_compute_performance_report
+    assert "compute id 1" in rep and "workitems" in rep
+    cr.dispose()
