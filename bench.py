@@ -293,8 +293,8 @@ def main() -> None:
     # ~99% transfer, so r/c/w overlap is physically unobservable) and
     # balanced (compute ~ transfers, where the EVENT engine's overlap is
     # the measurable property).
-    ov = measure_stream_overlap(devs, n=1 << 22, blobs=8)
-    ovb = measure_stream_overlap(devs, n=1 << 22, blobs=8, heavy_iters=15000)
+    ov = measure_stream_overlap(devs, n=1 << 22, blobs=8, reps=5)
+    ovb = measure_stream_overlap(devs, n=1 << 22, blobs=8, reps=5, heavy_iters=30000)
 
     # Roofline accounting.
     mean_iters = float(np.mean(full.image)) if full.image is not None else max_iter / 4

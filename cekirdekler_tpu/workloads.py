@@ -103,13 +103,16 @@ __kernel void streamAdd(__global float* a, __global float* b, __global float* c)
 # commensurate with blob transfer time — the regime where the pipeline
 # engines' read/compute/write overlap is actually measurable (on a slow
 # host link, plain streamAdd is ~99% transfer and overlap is unobservable).
+# The accumulation is EXACT in f32 (quarter-integer partial sums well below
+# 2^24), so the result has a closed form the caller can assert against —
+# a decaying recurrence has f32 fixed points a float64 model cannot predict.
 STREAM_HEAVY_SRC = """
 __kernel void streamHeavy(__global float* a, __global float* b, __global float* c,
                           int iters) {
     int i = get_global_id(0);
     float acc = a[i];
     for (int k = 0; k < iters; k++) {
-        acc = acc * 0.9999999f + b[i] * 0.0000001f;
+        acc = acc + b[i] * 0.25f;
     }
     c[i] = acc;
 }
@@ -542,18 +545,11 @@ def measure_stream_overlap(
             for k in ("r", "w", "p")
         )
         if heavy_iters:
-            # closed form of acc_{k+1} = acc_k*r + b*s iterated n times
-            # (r, s taken at their f32-rounded values):
-            #   acc_n = a*r^n + b*s*(1 - r^n)/(1 - r)
-            # — the timing numbers are only publishable if the pipelined
-            # path computed the right thing
-            r = float(np.float32(0.9999999))
-            s = float(np.float32(0.0000001))
-            rn = r ** heavy_iters
-            want = a.host() * rn + b.host() * s * (1.0 - rn) / (1.0 - r)
-            np.testing.assert_allclose(
-                np.asarray(c.host(), np.float64), want, rtol=1e-3, atol=1e-3
-            )
+            # acc = a + iters*(b/4), exact in f32 (quarter-integer sums
+            # below 2^24) — the timing numbers are only publishable if the
+            # pipelined path computed the right thing
+            want = a.host() + heavy_iters * 0.25 * b.host()
+            np.testing.assert_allclose(c.host(), want, rtol=1e-6)
         else:
             np.testing.assert_allclose(c.host(), a.host() + b.host())
         return {
