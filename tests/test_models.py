@@ -166,3 +166,37 @@ def test_remat_matches_no_remat():
     got = model.apply(params, toks)
     want = Transformer(_cfg()).apply(params, toks)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-6)
+
+
+def test_checkpoint_resume_is_deterministic(tmp_path):
+    """Checkpoint/resume (SURVEY §5.4 — the subsystem the reference lacks
+    entirely): save params+opt_state mid-train, resume in a fresh
+    optimizer/step, and the remaining steps must reproduce the original
+    run's losses exactly."""
+    from cekirdekler_tpu.utils import checkpoint as ckpt
+
+    cfg = _cfg()
+    model = Transformer(cfg)
+    params = model.init(jax.random.PRNGKey(7))
+    opt = optax.adamw(1e-2)
+    opt_state = opt.init(params)
+    step = jax.jit(model.make_train_step(opt))
+    rng = np.random.default_rng(7)
+    batches = [_batch(rng, 4, 16, cfg.vocab) for _ in range(4)]
+
+    losses = []
+    for i, b in enumerate(batches):
+        params, opt_state, loss = step(params, opt_state, b)
+        losses.append(float(loss))
+        if i == 1:
+            ckpt.save_pytree(str(tmp_path), 2, {"params": params, "opt": opt_state})
+
+    state = ckpt.load_pytree(
+        str(tmp_path), {"params": params, "opt": opt_state}, step=2
+    )
+    p2, o2 = state["params"], state["opt"]
+    resumed = []
+    for b in batches[2:]:
+        p2, o2, loss = step(p2, o2, b)
+        resumed.append(float(loss))
+    np.testing.assert_allclose(resumed, losses[2:], rtol=1e-6)
