@@ -830,9 +830,10 @@ def _exec_loop(ctx: _Ctx, node) -> None:
 
     # Free-run predication elimination: a carried variable that is never
     # read AFTER the loop needs no per-lane where-freeze — once a lane's
-    # active bit clears it can never re-set (new_active ANDs the old), so a
-    # dead lane's free-running value only feeds the cond (ANDed away) and
-    # masked stores.  This is the optimization the hand-written mandelbrot
+    # active bit clears it can never re-set (each pass computes
+    # ``active = prev AND cond``, monotone in ``prev``), so a dead lane's
+    # free-running value only feeds the cond (ANDed away) and masked
+    # stores.  This is the optimization the hand-written mandelbrot
     # kernel applies manually (ops/mandelbrot.py: escaped orbits free-run
     # to inf) and removes the dominant per-iteration where chain.  Only at
     # top level (in_loop == 0): inside an enclosing loop the body re-runs,
@@ -871,9 +872,6 @@ def _exec_loop(ctx: _Ctx, node) -> None:
 
     init_env = {k: ctx.env[k].value for k in carried_vars}
     init_bufs = {k: ctx.bufs[k] for k in carried_bufs}
-    active0 = eval_cond(init_env, init_bufs)
-    if outer_mask is not None:
-        active0 = jnp.logical_and(active0, outer_mask)
 
     # Pallas/Mosaic: no bool array in a while-loop carry (relayout
     # limitation — the same constraint the hand-written mandelbrot kernel
@@ -887,15 +885,26 @@ def _exec_loop(ctx: _Ctx, node) -> None:
     def from_carry_mask(m):
         return (m > 0.0) if mask_in_carry_f32 else m
 
+    # ROTATED loop: the carry holds the mask of lanes that executed the
+    # PREVIOUS pass; each body pass evaluates the condition FIRST (on the
+    # carried state), ANDs it in, and executes under that mask.  Putting
+    # cond and body in the same trace lets XLA CSE their shared
+    # subexpressions (the end-of-body placement recomputed e.g. zx*zx both
+    # in the cond and in the next pass's body — ~15% of mandelbrot's
+    # per-iteration work).  Price: one trailing fully-masked pass before
+    # cond_fun sees an all-false mask (and one masked pass for loops never
+    # entered) — masked execution has no observable effects.
+    prev0 = outer_mask if outer_mask is not None else jnp.ones(ctx.shape, jnp.bool_)
+
     def cond_fun(carry):
-        active, _, _ = carry
+        prev, _, _ = carry
         if mask_in_carry_f32:
-            return jnp.sum(active) > 0.0
-        return jnp.any(active)
+            return jnp.sum(prev) > 0.0
+        return jnp.any(prev)
 
     def body_fun(carry):
-        active, env_vals, buf_vals = carry
-        active = from_carry_mask(active)
+        prev, env_vals, buf_vals = carry
+        prev = from_carry_mask(prev)
         saved_env, saved_bufs, saved_mask = dict(ctx.env), dict(ctx.bufs), ctx.mask
         saved_stored = set(ctx.stored)
         saved_rm = ctx.return_mask
@@ -907,6 +916,7 @@ def _exec_loop(ctx: _Ctx, node) -> None:
             for k in carried_bufs:
                 ctx.bufs[k] = buf_vals[k]
             ctx._pad_cache.clear()  # buffers swapped to loop tracers
+            active = jnp.logical_and(prev, eval_cond(env_vals, buf_vals))
             ctx.mask = active
             ctx.return_mask = None
             # assignments whose mask is EXACTLY this loop's active mask may
@@ -928,8 +938,7 @@ def _exec_loop(ctx: _Ctx, node) -> None:
             for k in set(ctx.env.keys()) - env_keys_before:
                 del ctx.env[k]
                 ctx.private.pop(k, None)
-            new_active = jnp.logical_and(active, eval_cond(new_env, new_bufs))
-            return (to_carry_mask(new_active), new_env, new_bufs)
+            return (to_carry_mask(active), new_env, new_bufs)
         finally:
             ctx.info["in_loop"] -= 1
             ctx.env, ctx.bufs, ctx.mask = saved_env, saved_bufs, saved_mask
@@ -938,7 +947,7 @@ def _exec_loop(ctx: _Ctx, node) -> None:
             ctx._freerun = saved_fr
 
     active_f, env_f, bufs_f = lax.while_loop(
-        cond_fun, body_fun, (to_carry_mask(active0), init_env, init_bufs)
+        cond_fun, body_fun, (to_carry_mask(prev0), init_env, init_bufs)
     )
     ctx._pad_cache.clear()
     for k in carried_vars:
