@@ -186,6 +186,9 @@ class _Ctx:
         # private fixed-size arrays (``float acc[4];``): name -> length;
         # the env value is a (length, *shape) vector-per-element stack
         self.private: dict[str, int] = {}
+        # statically-proven lane-uniform locals (set by build_kernel_fn
+        # from _uniform_vars) — drives scalarized uniform-index loads
+        self.uniform_vars: set[str] = set()
 
     def broadcast_scalar(self, val, dtype):
         """Materialize a scalar as a full work-item vector of this ctx's
@@ -595,6 +598,16 @@ def _load(ctx: _Ctx, node: Index) -> KVal:
         padded, lo = ctx.padded_view(node.base, c)
         start = jnp.asarray(ctx.offset + c + lo, jnp.int32)
         return KVal(lax.dynamic_slice(padded, (start,), (ctx.B,)), ctype)
+    if ctx.uniform_vars and _expr_uniform(
+        node.index, ctx.uniform_vars, frozenset(ctx.private)
+    ):
+        # lane-uniform index (the n-body ``x[j]`` pattern): ONE element
+        # load broadcast to the chunk instead of a (B,)-wide gather per
+        # loop iteration — the dominant cost of gather-loop kernels
+        iv = _num(_as_dtype(idx, "int"))
+        sidx = iv if (not hasattr(iv, "ndim") or iv.ndim == 0) else iv.reshape(-1)[0]
+        sidx = jnp.clip(jnp.asarray(sidx, jnp.int32), 0, buf.shape[0] - 1)
+        return KVal(lax.dynamic_slice(buf, (sidx,), (1,))[0], ctype)
     iv = _num(_as_dtype(idx, "int"))
     if not hasattr(iv, "ndim") or iv.ndim == 0:
         iv = jnp.full((ctx.B,), iv, dtype=jnp.int32)
@@ -957,6 +970,155 @@ def _exec_loop(ctx: _Ctx, node) -> None:
         ctx.stored.add(k)
 
 
+# ---------------------------------------------------------------------------
+# uniformity analysis — which locals provably hold the SAME value in every
+# lane (work item) of a launch chunk.  A load indexed by a uniform
+# expression (the n-body pattern ``x[j]`` with a uniform loop counter) can
+# then be scalarized: one dynamic_slice element broadcast to the chunk,
+# instead of a (B,)-wide gather per loop iteration.
+# ---------------------------------------------------------------------------
+
+_UNIFORM_CALLS = {
+    "get_global_size", "get_local_size", "get_num_groups",
+    "get_global_offset", "get_work_dim",
+}
+_LANE_CALLS = {"get_global_id", "get_local_id", "get_group_id"}
+
+
+def _expr_uniform(node, uset: set[str], private: set[str] = frozenset()) -> bool:
+    """True iff ``node`` provably evaluates identically in every lane."""
+    if isinstance(node, Num):
+        return True
+    if isinstance(node, Var):
+        return node.name in uset
+    if isinstance(node, Index):
+        # a BUFFER load at a uniform index yields the same element in every
+        # lane; a PRIVATE array's rows are per-lane, so its loads never are
+        if node.base in private:
+            return False
+        return _expr_uniform(node.index, uset, private)
+    if isinstance(node, BinOp):
+        return (_expr_uniform(node.left, uset, private)
+                and _expr_uniform(node.right, uset, private))
+    if isinstance(node, UnOp):
+        return _expr_uniform(node.operand, uset, private)
+    if isinstance(node, Cast):
+        return _expr_uniform(node.operand, uset, private)
+    if isinstance(node, Ternary):
+        return (
+            _expr_uniform(node.cond, uset, private)
+            and _expr_uniform(node.then, uset, private)
+            and _expr_uniform(node.other, uset, private)
+        )
+    if isinstance(node, Call):
+        name = node.name
+        if name.startswith(("native_", "half_")):
+            name = name.split("_", 1)[1]
+        if name in _LANE_CALLS:
+            return False
+        if name in _UNIFORM_CALLS:
+            return True
+        return all(_expr_uniform(a, uset, private) for a in node.args)
+    return False  # unknown node kind: be conservative
+
+
+def _contains_return(stmts: list) -> bool:
+    for s in stmts:
+        if isinstance(s, Return):
+            return True
+        if isinstance(s, If) and (_contains_return(s.then) or _contains_return(s.other)):
+            return True
+        if isinstance(s, For):
+            inner = ([s.init] if s.init is not None else []) + s.body + (
+                [s.step] if s.step is not None else []
+            )
+            if _contains_return(inner):
+                return True
+        if isinstance(s, (While, DoWhile)) and _contains_return(s.body):
+            return True
+    return False
+
+
+def _private_array_names(stmts: list, out: set[str] | None = None) -> set[str]:
+    if out is None:
+        out = set()
+    for s in stmts:
+        if isinstance(s, Decl):
+            out.update(s.arrays)
+        elif isinstance(s, If):
+            _private_array_names(s.then, out)
+            _private_array_names(s.other, out)
+        elif isinstance(s, For):
+            if s.init is not None:
+                _private_array_names([s.init], out)
+            _private_array_names(s.body, out)
+        elif isinstance(s, (While, DoWhile)):
+            _private_array_names(s.body, out)
+    return out
+
+
+def _uniform_vars(body: list, value_params: set[str]) -> set[str]:
+    """Monotone-poisoning fixed point: start assuming every local is
+    uniform; poison any variable assigned a non-uniform value or assigned
+    under a non-uniform condition (divergent masks make merged values
+    differ per lane); repeat until stable."""
+    # an early `return` folds into a persistent per-lane return-mask that
+    # divergently suppresses EVERY later assignment — modeling which
+    # suffixes that poisons is subtle, and kernels with early returns are
+    # rare, so any Return disables the analysis outright (sound by
+    # construction; a divergent return once miscompiled a scalarized load
+    # here)
+    if _contains_return(body):
+        return set()
+    private = _private_array_names(body)
+    uset: set[str] = (set(value_params) | set(_assigned_vars(body))) - private
+    # declared-but-unassigned names also start uniform (zero-init)
+
+    changed = True
+    while changed:
+        changed = False
+
+        def poison(name: str) -> None:
+            nonlocal changed
+            if name in uset:
+                uset.discard(name)
+                changed = True
+
+        def walk(stmts, divergent: bool) -> None:
+            for s in stmts:
+                if isinstance(s, Decl):
+                    for name, init in s.names:
+                        if name in s.arrays:
+                            poison(name)  # per-lane stores make stacks diverge
+                        elif init is not None and not _expr_uniform(init, uset, private):
+                            poison(name)
+                        elif divergent and init is not None:
+                            poison(name)
+                elif isinstance(s, Assign) and isinstance(s.target, Var):
+                    if divergent or not _expr_uniform(s.value, uset, private):
+                        poison(s.target.name)
+                elif isinstance(s, CrementStmt) and isinstance(s.target, Var):
+                    if divergent:
+                        poison(s.target.name)
+                elif isinstance(s, If):
+                    d = divergent or not _expr_uniform(s.cond, uset, private)
+                    walk(s.then, d)
+                    walk(s.other, d)
+                elif isinstance(s, For):
+                    d = divergent
+                    if s.init is not None:
+                        walk([s.init], d)
+                    cond_u = s.cond is None or _expr_uniform(s.cond, uset, private)
+                    d = d or not cond_u
+                    walk(s.body + ([s.step] if s.step is not None else []), d)
+                elif isinstance(s, (While, DoWhile)):
+                    d = divergent or not _expr_uniform(s.cond, uset, private)
+                    walk(s.body, d)
+
+        walk(body, False)
+    return uset
+
+
 def _vars_read(node, out: set[str] | None = None) -> set[str]:
     """Every variable NAME referenced anywhere under ``node`` (statements,
     expressions, conditions, indices).  Conservative liveness input for
@@ -1085,8 +1247,11 @@ def build_kernel_fn(
         stored_params=[],
     )
 
+    uniform = _uniform_vars(kernel.body, {p.name for p in value_params})
+
     def fn(offset, arrays: tuple, values: tuple = ()):
         ctx = _Ctx(chunk, jnp.asarray(offset, jnp.int32), global_size, local_size, {})
+        ctx.uniform_vars = uniform
         for p, arr in zip(array_params, arrays):
             ctx.bufs[p.name] = arr
             ctx.buf_ctypes[p.name] = p.ctype

@@ -741,3 +741,67 @@ def test_private_array_loop_local_scopes_out():
         np.testing.assert_allclose(np.asarray(out), 1.0 + 10.0)
     finally:
         cr.dispose()
+
+
+def test_uniform_analysis_disabled_by_early_return():
+    """Regression (confirmed miscompilation): a lane-divergent early
+    return suppresses later assignments per-lane, so a variable assigned
+    after it is NOT uniform — any `return` disables scalarized loads."""
+    import numpy as np
+
+    from cekirdekler_tpu import ClArray
+    from cekirdekler_tpu.core.cruncher import NumberCruncher
+    from cekirdekler_tpu.hardware import platforms
+
+    src = """
+    __kernel void k(__global float* x, __global float* y) {
+        int i = get_global_id(0);
+        int j = 0;
+        if (i == 0) {
+            return;
+        }
+        j = 1;
+        y[i] = x[j];
+    }"""
+    cr = NumberCruncher(platforms().cpus().subset(1), src)
+    try:
+        x = ClArray(np.array([10.0, 20.0, 30.0, 40.0], np.float32), name="x")
+        y = ClArray(np.zeros(4, np.float32), name="y")
+        x.next_param(y).compute(cr, 1, "k", 4, 2)
+        np.testing.assert_allclose(np.asarray(y), [0.0, 20.0, 20.0, 20.0])
+    finally:
+        cr.dispose()
+
+
+def test_uniform_scalarized_gather_loop_matches():
+    """The n-body pattern: a gather loop with a uniform counter must
+    scalarize and still match the per-lane reference."""
+    import numpy as np
+
+    from cekirdekler_tpu import ClArray
+    from cekirdekler_tpu.core.cruncher import NumberCruncher
+    from cekirdekler_tpu.hardware import platforms
+
+    src = """
+    __kernel void dotrow(__global float* w, __global float* x, __global float* out,
+                         int n) {
+        int i = get_global_id(0);
+        float acc = 0.0f;
+        for (int j = 0; j < n; j++) {
+            acc = acc + w[j] * x[i];
+        }
+        out[i] = acc;
+    }"""
+    cr = NumberCruncher(platforms().cpus().subset(2), src)
+    try:
+        rng = np.random.default_rng(3)
+        # w sized to the global range (validation requires it); only the
+        # first 16 entries participate in the loop
+        w = ClArray(rng.standard_normal(128).astype(np.float32), name="w")
+        x = ClArray(rng.standard_normal(128).astype(np.float32), name="x", partial_read=True)
+        out = ClArray(128, np.float32, name="out")
+        w.next_param(x, out).compute(cr, 1, "dotrow", 128, 64, values=(16,))
+        want = np.float32(w.host()[:16].sum()) * x.host()
+        np.testing.assert_allclose(np.asarray(out), want, rtol=1e-5)
+    finally:
+        cr.dispose()
