@@ -203,13 +203,16 @@ class _Ctx:
 
     def padded_view(self, name: str, c: int):
         """Buffer padded so the shifted window [offset+c, offset+c+B) is
-        always in bounds; returns (padded, left_pad)."""
+        always in bounds; returns (padded, left_pad).  Edge padding so an
+        out-of-range element reads the nearest valid one — the SAME clamp
+        semantics as the gather path (a zero pad would give the two load
+        paths different out-of-bounds values for the same kernel)."""
         cache = self._pad_cache.setdefault(name, {})
         if c in cache:
             return cache[c]
         buf = self.bufs[name]
         lo, hi = max(0, -c), max(0, c)
-        padded = jnp.pad(buf, (lo, hi))
+        padded = jnp.pad(buf, (lo, hi), mode="edge")
         cache[c] = (padded, lo)
         return padded, lo
 

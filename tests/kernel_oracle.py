@@ -1,0 +1,330 @@
+"""Scalar oracle interpreter for the kernel language.
+
+Executes a parsed kernel ONE WORK ITEM AT A TIME with real Python control
+flow — no vectorization, no masks, no lowering tricks. This is the
+semantic reference the compiled lowerings (vectorized XLA and Pallas
+tiles) are differentially fuzzed against: any divergence is a compiler
+bug, because per-item sequential execution IS the language's definition
+(each kernel invocation describes one work item; cross-item hazards are
+excluded by the test generators, as OpenCL leaves them undefined anyway).
+
+Matches the lowerings' documented edge choices: C truncating integer
+division/remainder, clamped out-of-bounds loads, clamped private-array
+indices, f32 arithmetic for float locals.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from cekirdekler_tpu.kernel.lang import (
+    Assign,
+    BinOp,
+    Call,
+    Cast,
+    CrementStmt,
+    Decl,
+    DoWhile,
+    For,
+    If,
+    Index,
+    KernelDef,
+    Num,
+    Return,
+    Ternary,
+    UnOp,
+    Var,
+    While,
+)
+
+_NPT = {
+    "bool": np.bool_, "char": np.int8, "uchar": np.uint8,
+    "short": np.int16, "ushort": np.uint16, "int": np.int32,
+    "uint": np.uint32, "long": np.int64, "ulong": np.uint64,
+    "half": np.float16, "float": np.float32, "double": np.float64,
+}
+_INT = {"bool", "char", "uchar", "short", "ushort", "int", "uint", "long", "ulong"}
+
+
+class _Return(Exception):
+    pass
+
+
+_UNARY = {
+    "sqrt": math.sqrt, "rsqrt": lambda x: 1.0 / math.sqrt(x),
+    "cbrt": lambda x: math.copysign(abs(x) ** (1.0 / 3.0), x),
+    "exp": math.exp, "exp2": lambda x: 2.0 ** x, "exp10": lambda x: 10.0 ** x,
+    "log": math.log, "log2": math.log2, "log10": math.log10,
+    "sin": math.sin, "cos": math.cos, "tan": math.tan,
+    "asin": math.asin, "acos": math.acos, "atan": math.atan,
+    "sinh": math.sinh, "cosh": math.cosh, "tanh": math.tanh,
+    "asinh": math.asinh, "acosh": math.acosh, "atanh": math.atanh,
+    "fabs": abs, "floor": math.floor, "ceil": math.ceil,
+    "round": lambda x: float(np.round(np.float64(x))), "rint": lambda x: float(np.round(np.float64(x))),
+    "trunc": math.trunc, "erf": math.erf, "erfc": math.erfc,
+    "degrees": math.degrees, "radians": math.radians,
+    "sign": lambda x: float(np.sign(x)),
+}
+_BINARY = {
+    "pow": math.pow, "powr": math.pow, "atan2": math.atan2,
+    "fmod": math.fmod, "remainder": math.remainder, "hypot": math.hypot,
+    "copysign": math.copysign,
+    "fdim": lambda a, b: max(a - b, 0.0),
+    "nextafter": math.nextafter,
+}
+
+
+class Oracle:
+    """Per-item executor: ``run(arrays, values, global_size)`` mutates the
+    numpy arrays in place, looping items sequentially."""
+
+    def __init__(self, kernel: KernelDef, local_size: int = 64):
+        self.kernel = kernel
+        self.local_size = local_size
+
+    def run(self, arrays: dict[str, np.ndarray], values: dict[str, float],
+            global_size: int, offset: int = 0) -> None:
+        for i in range(offset, offset + global_size):
+            self._run_item(i, arrays, values, global_size)
+
+    # -- one work item -------------------------------------------------------
+    def _run_item(self, gid, arrays, values, gsize) -> None:
+        env: dict = {}
+        priv: dict[str, np.ndarray] = {}
+        ctypes: dict[str, str] = {}
+        for p in self.kernel.params:
+            if not p.is_pointer:
+                env[p.name] = _NPT[p.ctype](values[p.name])
+                ctypes[p.name] = p.ctype
+        state = (env, priv, ctypes, arrays, gid, gsize)
+        try:
+            self._block(self.kernel.body, state)
+        except _Return:
+            pass
+
+    def _block(self, stmts, state) -> None:
+        for s in stmts:
+            self._stmt(s, state)
+
+    def _stmt(self, s, state) -> None:
+        env, priv, ctypes, arrays, gid, gsize = state
+        if isinstance(s, Decl):
+            for name, init in s.names:
+                if name in s.arrays:
+                    priv[name] = np.zeros(s.arrays[name], _NPT[s.ctype])
+                    ctypes[name] = s.ctype
+                else:
+                    v = self._expr(init, state) if init is not None else 0
+                    env[name] = _NPT[s.ctype](v)
+                    ctypes[name] = s.ctype
+        elif isinstance(s, Assign):
+            if s.target is None:
+                self._expr(s.value, state)
+                return
+            rhs = self._expr(s.value, state)
+            if s.op != "=":
+                cur = self._expr(s.target, state)
+                rhs = self._binval(s.op[:-1], cur, rhs)
+            self._store(s.target, rhs, state)
+        elif isinstance(s, CrementStmt):
+            cur = self._expr(s.target, state)
+            self._store(s.target, cur + (1 if s.op == "++" else -1), state)
+        elif isinstance(s, If):
+            if isinstance(s.cond, Num) and s.cond.value == 1 and not s.other:
+                self._block(s.then, state)
+            elif self._truthy(self._expr(s.cond, state)):
+                self._block(s.then, state)
+            else:
+                self._block(s.other, state)
+        elif isinstance(s, For):
+            if s.init is not None:
+                self._stmt(s.init, state)
+            while s.cond is None or self._truthy(self._expr(s.cond, state)):
+                self._block(s.body, state)
+                if s.step is not None:
+                    self._stmt(s.step, state)
+        elif isinstance(s, While):
+            while self._truthy(self._expr(s.cond, state)):
+                self._block(s.body, state)
+        elif isinstance(s, DoWhile):
+            while True:
+                self._block(s.body, state)
+                if not self._truthy(self._expr(s.cond, state)):
+                    break
+        elif isinstance(s, Return):
+            raise _Return()
+        else:
+            raise AssertionError(f"oracle: unhandled stmt {type(s).__name__}")
+
+    def _store(self, target, val, state) -> None:
+        env, priv, ctypes, arrays, gid, gsize = state
+        if isinstance(target, Var):
+            env[target.name] = _NPT[ctypes[target.name]](val)
+            return
+        assert isinstance(target, Index)
+        idx = int(self._expr(target.index, state))
+        if target.base in priv:
+            arr = priv[target.base]
+            arr[np.clip(idx, 0, arr.shape[0] - 1)] = val
+        else:
+            arr = arrays[target.base]
+            # matches the lowering: masked scatter drops OOB; in-range writes land
+            if 0 <= idx < arr.shape[0]:
+                arr[idx] = val
+
+    def _expr(self, node, state):
+        env, priv, ctypes, arrays, gid, gsize = state
+        if isinstance(node, Num):
+            return _NPT[node.ctype](node.value)
+        if isinstance(node, Var):
+            return env[node.name]
+        if isinstance(node, Index):
+            idx = int(self._expr(node.index, state))
+            if node.base in priv:
+                arr = priv[node.base]
+            else:
+                arr = arrays[node.base]
+            return arr[np.clip(idx, 0, arr.shape[0] - 1)]  # clamped loads
+        if isinstance(node, UnOp):
+            v = self._expr(node.operand, state)
+            if node.op == "+":
+                return v
+            if node.op == "-":
+                return -v
+            if node.op == "!":
+                return np.bool_(not self._truthy(v))
+            if node.op == "~":
+                return ~np.int32(v) if not isinstance(v, np.integer) else ~v
+        if isinstance(node, Ternary):
+            c = self._truthy(self._expr(node.cond, state))
+            return self._expr(node.then if c else node.other, state)
+        if isinstance(node, Cast):
+            return _NPT[node.ctype](self._expr(node.operand, state))
+        if isinstance(node, BinOp):
+            if node.op == "&&":
+                return np.bool_(
+                    self._truthy(self._expr(node.left, state))
+                    and self._truthy(self._expr(node.right, state))
+                )
+            if node.op == "||":
+                return np.bool_(
+                    self._truthy(self._expr(node.left, state))
+                    or self._truthy(self._expr(node.right, state))
+                )
+            a = self._expr(node.left, state)
+            b = self._expr(node.right, state)
+            return self._binval(node.op, a, b)
+        if isinstance(node, Call):
+            return self._call(node, state)
+        raise AssertionError(f"oracle: unhandled expr {type(node).__name__}")
+
+    def _binval(self, op, a, b):
+        # promote like the lowering: float wins; ints promote to >= int32
+        if isinstance(a, np.floating) or isinstance(b, np.floating):
+            fa = np.float32(a) if not isinstance(a, np.float64) and not isinstance(b, np.float64) else np.float64(a)
+            fb = type(fa)(b)
+            if op == "+":
+                return fa + fb
+            if op == "-":
+                return fa - fb
+            if op == "*":
+                return fa * fb
+            if op == "/":
+                return fa / fb
+            if op == "%":
+                return type(fa)(math.fmod(float(fa), float(fb)))
+            return self._cmp(op, fa, fb)
+        ia, ib = np.int64(a), np.int64(b)
+        if op == "+":
+            return np.int32(ia + ib)
+        if op == "-":
+            return np.int32(ia - ib)
+        if op == "*":
+            return np.int32(ia * ib)
+        if op == "/":
+            q = abs(ia) // abs(ib)
+            return np.int32(q if (ia >= 0) == (ib >= 0) else -q)  # C trunc
+        if op == "%":
+            return np.int32(ia - np.int64(self._binval("/", a, b)) * ib)
+        if op == "&":
+            return np.int32(ia & ib)
+        if op == "|":
+            return np.int32(ia | ib)
+        if op == "^":
+            return np.int32(ia ^ ib)
+        if op == "<<":
+            return np.int32(ia << ib)
+        if op == ">>":
+            return np.int32(ia >> ib)
+        return self._cmp(op, ia, ib)
+
+    @staticmethod
+    def _cmp(op, a, b):
+        return np.bool_(
+            {"==": a == b, "!=": a != b, "<": a < b, ">": a > b,
+             "<=": a <= b, ">=": a >= b}[op]
+        )
+
+    @staticmethod
+    def _truthy(v) -> bool:
+        return bool(v)
+
+    def _call(self, node: Call, state):
+        env, priv, ctypes, arrays, gid, gsize = state
+        name = node.name
+        if name.startswith(("native_", "half_")):
+            name = name.split("_", 1)[1]
+        args = [self._expr(a, state) for a in node.args]
+        if name == "get_global_id":
+            return np.int32(gid)
+        if name == "get_global_size":
+            return np.int32(gsize)
+        if name == "get_local_size":
+            return np.int32(self.local_size)
+        if name == "get_local_id":
+            return np.int32(gid % self.local_size)
+        if name == "get_group_id":
+            return np.int32(gid // self.local_size)
+        if name == "get_num_groups":
+            return np.int32(gsize // self.local_size)
+        if name == "get_global_offset":
+            return np.int32(0)
+        if name == "get_work_dim":
+            return np.int32(1)
+        if name in _UNARY:
+            if name in ("fabs", "sign") and isinstance(args[0], np.integer):
+                return abs(args[0]) if name == "fabs" else np.int32(np.sign(args[0]))
+            return np.float32(_UNARY[name](float(np.float32(args[0]))))
+        if name in _BINARY:
+            return np.float32(_BINARY[name](float(np.float32(args[0])),
+                                            float(np.float32(args[1]))))
+        if name == "abs":
+            return abs(args[0])
+        if name in ("min", "fmin"):
+            return min(args[0], args[1])
+        if name in ("max", "fmax"):
+            return max(args[0], args[1])
+        if name == "clamp":
+            return min(max(args[0], args[1]), args[2])
+        if name in ("mad", "fma"):
+            return np.float32(np.float32(args[0]) * np.float32(args[1]) + np.float32(args[2]))
+        if name == "mix":
+            a, b, w = (np.float32(x) for x in args)
+            return np.float32(a + (b - a) * w)
+        if name == "step":
+            return np.float32(0.0 if float(args[1]) < float(args[0]) else 1.0)
+        if name == "smoothstep":
+            e0, e1, x = (float(x) for x in args)
+            u = min(max((x - e0) / (e1 - e0), 0.0), 1.0)
+            return np.float32(u * u * (3.0 - 2.0 * u))
+        if name == "select":
+            return args[1] if self._truthy(args[2]) else args[0]
+        if name == "isnan":
+            return np.bool_(math.isnan(float(args[0])))
+        if name == "isinf":
+            return np.bool_(math.isinf(float(args[0])))
+        if name == "isfinite":
+            return np.bool_(math.isfinite(float(args[0])))
+        raise AssertionError(f"oracle: unknown function {node.name}")
