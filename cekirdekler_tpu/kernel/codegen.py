@@ -47,8 +47,10 @@ from . import lang
 from .lang import (
     Assign,
     BinOp,
+    Break,
     Call,
     Cast,
+    Continue,
     CrementStmt,
     Decl,
     For,
@@ -186,6 +188,11 @@ class _Ctx:
         # private fixed-size arrays (``float acc[4];``): name -> length;
         # the env value is a (length, *shape) vector-per-element stack
         self.private: dict[str, int] = {}
+        # per-innermost-loop masks: lanes that executed `break` (persist
+        # for the loop's remaining iterations) / `continue` (reset per
+        # iteration) — saved and restored by _exec_loop
+        self.break_mask: Any = None
+        self.continue_mask: Any = None
         # statically-proven lane-uniform locals (set by build_kernel_fn
         # from _uniform_vars) — drives scalarized uniform-index loads
         self.uniform_vars: set[str] = set()
@@ -220,11 +227,13 @@ class _Ctx:
         self._pad_cache.pop(name, None)
 
     def active_mask(self):
-        """Combined current mask (branch mask minus returned items)."""
+        """Combined current mask (branch mask minus returned / broken /
+        continued items)."""
         m = self.mask
-        if self.return_mask is not None:
-            rm = jnp.logical_not(self.return_mask)
-            m = rm if m is None else jnp.logical_and(m, rm)
+        for excl in (self.return_mask, self.break_mask, self.continue_mask):
+            if excl is not None:
+                inv = jnp.logical_not(excl)
+                m = inv if m is None else jnp.logical_and(m, inv)
         return m
 
 
@@ -724,13 +733,49 @@ def _exec(ctx: _Ctx, node) -> None:
         # body once unconditionally (under the active mask), then the loop.
         # The first pass counts as "inside a loop" for nested loops: the
         # body re-runs via the While, so an inner loop's free-run liveness
-        # cannot be derived from the remainder stack alone
+        # cannot be derived from the remainder stack alone.  break/continue
+        # in the first pass bind to THIS do-while: continue skips the rest
+        # of the pass, break also excludes the lane from the While.
+        saved_bk, saved_cn = ctx.break_mask, ctx.continue_mask
+        ctx.break_mask = None
+        ctx.continue_mask = None
         ctx.info["in_loop"] = ctx.info.get("in_loop", 0) + 1
         try:
             _exec_block(ctx, node.body)
         finally:
             ctx.info["in_loop"] -= 1
-        _exec_loop(ctx, While(cond=node.cond, body=node.body, line=node.line))
+            first_broke = ctx.break_mask
+            ctx.break_mask, ctx.continue_mask = saved_bk, saved_cn
+        loop = While(cond=node.cond, body=node.body, line=node.line)
+        if first_broke is not None:
+            outer = ctx.mask
+            nb = jnp.logical_not(first_broke)
+            ctx.mask = nb if outer is None else jnp.logical_and(outer, nb)
+            try:
+                _exec_loop(ctx, loop)
+            finally:
+                ctx.mask = outer
+        else:
+            _exec_loop(ctx, loop)
+        return
+    if isinstance(node, (Break, Continue)):
+        if not ctx.info.get("in_loop", 0):
+            raise KernelLanguageError(
+                f"'{'break' if isinstance(node, Break) else 'continue'}' "
+                "outside a loop", line=node.line,
+            )
+        m = ctx.active_mask()
+        if m is None:
+            m = jnp.ones(ctx.shape, jnp.bool_)
+        if isinstance(node, Break):
+            ctx.break_mask = (
+                m if ctx.break_mask is None else jnp.logical_or(ctx.break_mask, m)
+            )
+        else:
+            ctx.continue_mask = (
+                m if ctx.continue_mask is None
+                else jnp.logical_or(ctx.continue_mask, m)
+            )
         return
     if isinstance(node, Return):
         m = ctx.active_mask()
@@ -835,9 +880,11 @@ def _exec_loop(ctx: _Ctx, node) -> None:
             _exec(ctx, node.init)
         cond_expr = node.cond if node.cond is not None else Num(value=1, ctype="int", line=node.line)
         body = list(node.body) + ([node.step] if node.step is not None else [])
+        body_core, step_stmt = list(node.body), node.step
     else:
         cond_expr = node.cond
         body = list(node.body)
+        body_core, step_stmt = body, None
 
     carried_vars = sorted(_assigned_vars(body) & set(ctx.env.keys()))
     carried_bufs = sorted(_stored_bufs(body) & set(ctx.bufs.keys()))
@@ -925,6 +972,7 @@ def _exec_loop(ctx: _Ctx, node) -> None:
         saved_stored = set(ctx.stored)
         saved_rm = ctx.return_mask
         saved_fr = ctx._freerun
+        saved_bk, saved_cn = ctx.break_mask, ctx.continue_mask
         ctx.info["in_loop"] = ctx.info.get("in_loop", 0) + 1
         try:
             for k in carried_vars:
@@ -935,11 +983,18 @@ def _exec_loop(ctx: _Ctx, node) -> None:
             active = jnp.logical_and(prev, eval_cond(env_vals, buf_vals))
             ctx.mask = active
             ctx.return_mask = None
+            ctx.break_mask = None      # break binds to THIS loop
+            ctx.continue_mask = None
             # assignments whose mask is EXACTLY this loop's active mask may
             # skip the where-merge for free-run variables (see above)
             ctx._freerun = (active, freerun) if freerun else None
             env_keys_before = set(ctx.env.keys())
-            _exec_block(ctx, body)
+            _exec_block(ctx, body_core)
+            # C semantics: `continue` jumps to the for-step (which still
+            # runs for continued lanes); `break` skips it too
+            ctx.continue_mask = None
+            if step_stmt is not None:
+                _exec(ctx, step_stmt)
             if ctx.return_mask is not None:
                 raise KernelLanguageError(
                     "'return' inside a loop is not supported; use the loop condition",
@@ -954,13 +1009,20 @@ def _exec_loop(ctx: _Ctx, node) -> None:
             for k in set(ctx.env.keys()) - env_keys_before:
                 del ctx.env[k]
                 ctx.private.pop(k, None)
-            return (to_carry_mask(active), new_env, new_bufs)
+            # lanes that broke leave the loop for good
+            out_active = (
+                active
+                if ctx.break_mask is None
+                else jnp.logical_and(active, jnp.logical_not(ctx.break_mask))
+            )
+            return (to_carry_mask(out_active), new_env, new_bufs)
         finally:
             ctx.info["in_loop"] -= 1
             ctx.env, ctx.bufs, ctx.mask = saved_env, saved_bufs, saved_mask
             ctx.stored = saved_stored | ctx.stored
             ctx.return_mask = saved_rm
             ctx._freerun = saved_fr
+            ctx.break_mask, ctx.continue_mask = saved_bk, saved_cn
 
     active_f, env_f, bufs_f = lax.while_loop(
         cond_fun, body_fun, (to_carry_mask(prev0), init_env, init_bufs)
@@ -1023,6 +1085,22 @@ def _expr_uniform(node, uset: set[str], private: set[str] = frozenset()) -> bool
             return True
         return all(_expr_uniform(a, uset, private) for a in node.args)
     return False  # unknown node kind: be conservative
+
+
+def _has_divergent_exit(stmts: list, divergent: bool, uset, private) -> bool:
+    """True if a break/continue can execute under a lane-divergent
+    condition anywhere in THIS loop's body (nested loops scope their own
+    break/continue and are checked when their own walk runs)."""
+    for s in stmts:
+        if isinstance(s, (Break, Continue)) and divergent:
+            return True
+        if isinstance(s, If):
+            d = divergent or not _expr_uniform(s.cond, uset, private)
+            if _has_divergent_exit(s.then, d, uset, private):
+                return True
+            if _has_divergent_exit(s.other, d, uset, private):
+                return True
+    return False
 
 
 def _contains_return(stmts: list) -> bool:
@@ -1113,9 +1191,15 @@ def _uniform_vars(body: list, value_params: set[str]) -> set[str]:
                         walk([s.init], d)
                     cond_u = s.cond is None or _expr_uniform(s.cond, uset, private)
                     d = d or not cond_u
-                    walk(s.body + ([s.step] if s.step is not None else []), d)
+                    inner = s.body + ([s.step] if s.step is not None else [])
+                    # a break/continue under a divergent condition makes
+                    # per-lane trip counts differ: every assignment in the
+                    # loop diverges
+                    d = d or _has_divergent_exit(s.body, d, uset, private)
+                    walk(inner, d)
                 elif isinstance(s, (While, DoWhile)):
                     d = divergent or not _expr_uniform(s.cond, uset, private)
+                    d = d or _has_divergent_exit(s.body, d, uset, private)
                     walk(s.body, d)
 
         walk(body, False)

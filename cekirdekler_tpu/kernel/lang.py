@@ -5,8 +5,8 @@ driver compiler (ClProgram.cs:62-73; kernel names are regex-extracted at
 ClNumberCruncher.cs:219-228).  TPUs cannot execute C, so we define the
 *supported kernel contract* (SURVEY.md §7 "kernel-language surface"): a
 C-like subset — ``__kernel void name(__global float* a, ...)`` functions with
-scalar locals, arithmetic, comparisons, ``if``/``for``/``while``, and the
-common math builtins — which the codegen (codegen.py) vectorizes over work
+scalar locals, arithmetic, comparisons, ``if``/``for``/``while`` with
+``break``/``continue``, and the common math builtins — which the codegen (codegen.py) vectorizes over work
 items and lowers to JAX/XLA.  Unsupported constructs (local memory, barriers,
 atomics, vector types, pointers beyond parameters) raise
 :class:`KernelLanguageError` with the offending line.
@@ -240,6 +240,16 @@ class Return(Node):
 
 
 @dataclass
+class Break(Node):
+    pass
+
+
+@dataclass
+class Continue(Node):
+    pass
+
+
+@dataclass
 class Param(Node):
     ctype: str        # element type for pointers, value type otherwise
     name: str
@@ -273,6 +283,7 @@ class _Parser:
         self.toks = tokens
         self.i = 0
         self.source = source
+        self._loop_depth = 0  # break/continue outside a loop = parse error
 
     # -- token helpers ------------------------------------------------------
     @property
@@ -435,11 +446,13 @@ class _Parser:
                     raise KernelLanguageError("kernels are void; 'return value;' unsupported", line=t.line)
                 return Return(line=t.line)
             if t.text == "break" or t.text == "continue":
-                raise KernelLanguageError(
-                    f"'{t.text}' is not supported in the vectorized kernel contract; "
-                    "restructure with the loop condition or an if-guard",
-                    line=t.line,
-                )
+                if self._loop_depth == 0:
+                    raise KernelLanguageError(
+                        f"'{t.text}' outside a loop", line=t.line
+                    )
+                self.advance()
+                self.expect(";")
+                return (Break if t.text == "break" else Continue)(line=t.line)
             if t.text in _TYPE_KWS or t.text == "const":
                 return self.parse_decl()
         stmt = self.parse_expr_statement()
@@ -544,7 +557,11 @@ class _Parser:
         if self.cur.text != ")":
             step = self.parse_expr_statement()
         self.expect(")")
-        body = self._stmt_as_block()
+        self._loop_depth += 1
+        try:
+            body = self._stmt_as_block()
+        finally:
+            self._loop_depth -= 1
         return For(init=init, cond=cond, step=step, body=body, line=line)
 
     def parse_while(self) -> While:
@@ -552,12 +569,20 @@ class _Parser:
         self.expect("(")
         cond = self.parse_expr()
         self.expect(")")
-        body = self._stmt_as_block()
+        self._loop_depth += 1
+        try:
+            body = self._stmt_as_block()
+        finally:
+            self._loop_depth -= 1
         return While(cond=cond, body=body, line=line)
 
     def parse_do(self) -> DoWhile:
         line = self.expect("do").line
-        body = self._stmt_as_block()
+        self._loop_depth += 1
+        try:
+            body = self._stmt_as_block()
+        finally:
+            self._loop_depth -= 1
         self.expect("while")
         self.expect("(")
         cond = self.parse_expr()

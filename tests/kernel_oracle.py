@@ -22,8 +22,10 @@ import numpy as np
 from cekirdekler_tpu.kernel.lang import (
     Assign,
     BinOp,
+    Break,
     Call,
     Cast,
+    Continue,
     CrementStmt,
     Decl,
     DoWhile,
@@ -49,6 +51,14 @@ _INT = {"bool", "char", "uchar", "short", "ushort", "int", "uint", "long", "ulon
 
 
 class _Return(Exception):
+    pass
+
+
+class _Break(Exception):
+    pass
+
+
+class _Continue(Exception):
     pass
 
 
@@ -142,17 +152,36 @@ class Oracle:
             if s.init is not None:
                 self._stmt(s.init, state)
             while s.cond is None or self._truthy(self._expr(s.cond, state)):
-                self._block(s.body, state)
+                try:
+                    self._block(s.body, state)
+                except _Break:
+                    break
+                except _Continue:
+                    pass  # C: continue still runs the step
                 if s.step is not None:
                     self._stmt(s.step, state)
         elif isinstance(s, While):
             while self._truthy(self._expr(s.cond, state)):
-                self._block(s.body, state)
+                try:
+                    self._block(s.body, state)
+                except _Break:
+                    break
+                except _Continue:
+                    continue
         elif isinstance(s, DoWhile):
             while True:
-                self._block(s.body, state)
+                try:
+                    self._block(s.body, state)
+                except _Break:
+                    break
+                except _Continue:
+                    pass
                 if not self._truthy(self._expr(s.cond, state)):
                     break
+        elif isinstance(s, Break):
+            raise _Break()
+        elif isinstance(s, Continue):
+            raise _Continue()
         elif isinstance(s, Return):
             raise _Return()
         else:

@@ -66,8 +66,6 @@ def test_unsupported_constructs():
     with pytest.raises(KernelLanguageError):
         parse_kernels("__kernel void f(__local float* s){}")
     with pytest.raises(KernelLanguageError):
-        parse_kernels("__kernel void f(__global float* a){ for(;;){ break; } }")
-    with pytest.raises(KernelLanguageError):
         parse_kernels("#define F(x) (x)\n__kernel void f(__global float* a){}")
 
 
@@ -807,3 +805,12 @@ def test_uniform_scalarized_gather_loop_matches():
         np.testing.assert_allclose(np.asarray(out), want, rtol=1e-5)
     finally:
         cr.dispose()
+
+
+def test_break_outside_loop_is_parse_error():
+    with pytest.raises(KernelLanguageError):
+        parse_kernels("__kernel void f(__global float* a){ break; }")
+    with pytest.raises(KernelLanguageError):
+        parse_kernels(
+            "__kernel void f(__global float* a){ if (a[0] > 0.0f) { continue; } }"
+        )

@@ -175,3 +175,113 @@ def test_oracle_random_gather_kernels(seed):
         "x": rng.standard_normal(N).astype(np.float32),
         "out": np.zeros(N, np.float32),
     }, {})
+
+
+def test_oracle_break_in_divergent_loop():
+    src = """
+    __kernel void k(__global float* x, __global float* out) {
+        int i = get_global_id(0);
+        float acc = 0.0f;
+        for (int j = 0; j < 20; j++) {
+            acc = acc + x[i] * 0.1f;
+            if (acc > 1.0f) {
+                break;
+            }
+            acc = acc + 0.01f;
+        }
+        out[i] = acc;
+    }"""
+    rng = np.random.default_rng(10)
+    _run_both(src, {
+        "x": (rng.standard_normal(N) * 2).astype(np.float32),
+        "out": np.zeros(N, np.float32),
+    }, {})
+
+
+def test_oracle_continue_skips_rest_but_runs_step():
+    src = """
+    __kernel void k(__global float* out) {
+        int i = get_global_id(0);
+        float s = 0.0f;
+        for (int j = 0; j < 10; j++) {
+            if (j % 2 == (i % 2)) {
+                continue;
+            }
+            s = s + (float)j;
+        }
+        out[i] = s;
+    }"""
+    _run_both(src, {"out": np.zeros(N, np.float32)}, {})
+
+
+def test_oracle_break_continue_mixed_while():
+    src = """
+    __kernel void k(__global float* x, __global float* out) {
+        int i = get_global_id(0);
+        float v = x[i];
+        int n = 0;
+        while (n < 30) {
+            n = n + 1;
+            if (v < 0.0f) {
+                v = v + 0.5f;
+                continue;
+            }
+            v = v * 0.8f;
+            if (v < 0.05f) {
+                break;
+            }
+        }
+        out[i] = v + (float)n;
+    }"""
+    rng = np.random.default_rng(11)
+    _run_both(src, {
+        "x": (rng.standard_normal(N) * 3).astype(np.float32),
+        "out": np.zeros(N, np.float32),
+    }, {})
+
+
+def test_oracle_break_in_do_while_first_pass():
+    src = """
+    __kernel void k(__global float* x, __global float* out) {
+        int i = get_global_id(0);
+        float v = x[i];
+        int n = 0;
+        do {
+            if (v > 1.0f) {
+                break;
+            }
+            v = v + 0.3f;
+            n = n + 1;
+        } while (n < 8);
+        out[i] = v + 10.0f * (float)n;
+    }"""
+    rng = np.random.default_rng(12)
+    _run_both(src, {
+        "x": (rng.standard_normal(N) * 2).astype(np.float32),
+        "out": np.zeros(N, np.float32),
+    }, {})
+
+
+def test_oracle_divergent_break_poisons_uniform_gather():
+    """A divergent break changes per-lane trip counts: a counter in such a
+    loop must NOT be treated as uniform for scalarized gathers."""
+    src = """
+    __kernel void k(__global float* x, __global float* w, __global float* out) {
+        int i = get_global_id(0);
+        int j = 0;
+        float acc = 0.0f;
+        while (j < 16) {
+            if (x[i] * (float)j > 4.0f) {
+                break;
+            }
+            acc = acc + w[j];
+            j = j + 1;
+        }
+        out[i] = acc;
+    }"""
+    rng = np.random.default_rng(13)
+    _run_both(src, {
+        "x": (rng.standard_normal(N) * 2).astype(np.float32),
+        "w": rng.standard_normal(N).astype(np.float32),
+        "out": np.zeros(N, np.float32),
+    }, {})
