@@ -196,6 +196,8 @@ class _Ctx:
         # statically-proven lane-uniform locals (set by build_kernel_fn
         # from _uniform_vars) — drives scalarized uniform-index loads
         self.uniform_vars: set[str] = set()
+        # helper functions (lang.FuncDef by name) inlined at call sites
+        self.helpers: dict = {}
 
     def broadcast_scalar(self, val, dtype):
         """Materialize a scalar as a full work-item vector of this ctx's
@@ -431,8 +433,59 @@ _UNSUPPORTED_CALLS = {
 }
 
 
+def _inline_helper(ctx: _Ctx, fdef, arg_nodes, call_line: int) -> KVal:
+    """Inline a helper call: evaluate args in the caller's scope, execute
+    the body in a FRESH scope — helpers see ONLY their params and locals
+    (no captured kernel variables, no buffers, no caller private arrays,
+    and no inherited uniformity facts, whose names are kernel-scoped) —
+    and return the final ``return expr;`` value coerced to the declared
+    return type."""
+    if len(arg_nodes) != len(fdef.params):
+        raise KernelCompileError(
+            f"helper {fdef.name!r} takes {len(fdef.params)} argument(s), "
+            f"got {len(arg_nodes)}", line=call_line,
+        )
+    stack = ctx.info.setdefault("inline_stack", [])
+    if fdef.name in stack:
+        raise KernelLanguageError(
+            f"recursive helper call {fdef.name!r} is not supported",
+            line=call_line,
+        )
+    vals = [_eval(ctx, a) for a in arg_nodes]
+    saved_env, saved_priv = ctx.env, ctx.private
+    saved_bufs, saved_bct = ctx.bufs, ctx.buf_ctypes
+    saved_uniform = ctx.uniform_vars
+    ctx.env = {
+        p.name: _as_dtype(v, p.ctype) for p, v in zip(fdef.params, vals)
+    }
+    ctx.private = {}
+    ctx.bufs = {}
+    ctx.buf_ctypes = {}
+    ctx.uniform_vars = set()
+    stack.append(fdef.name)
+    # the final `return expr;` still READS helper locals after any loops in
+    # the body — it must be visible to free-run liveness (else a loop
+    # would treat the returned accumulator as dead-after and skip its
+    # per-lane freeze)
+    ctx._after_stack.append([fdef.body[-1]])
+    try:
+        _exec_block(ctx, fdef.body[:-1])
+        ret = _eval(ctx, fdef.body[-1].value)
+        return _as_dtype(ret, fdef.ret_ctype)
+    finally:
+        ctx._after_stack.pop()
+        stack.pop()
+        ctx.env = saved_env
+        ctx.private = saved_priv
+        ctx.bufs = saved_bufs
+        ctx.buf_ctypes = saved_bct
+        ctx.uniform_vars = saved_uniform
+
+
 def _call(ctx: _Ctx, node: Call) -> KVal:
     name = node.name
+    if name in ctx.helpers:
+        return _inline_helper(ctx, ctx.helpers[name], node.args, node.line)
     if name.startswith("native_") or name.startswith("half_"):
         name = name.split("_", 1)[1]
     if name.startswith("atomic_") or name.startswith("atom_"):
@@ -1048,6 +1101,11 @@ _UNIFORM_CALLS = {
     "get_global_offset", "get_work_dim",
 }
 _LANE_CALLS = {"get_global_id", "get_local_id", "get_group_id"}
+_PURE_BUILTINS = (
+    set(_UNARY_FLOAT) | set(_BINARY_FLOAT)
+    | {"abs", "min", "max", "fmin", "fmax", "clamp", "mad", "fma", "mix",
+       "step", "smoothstep", "select", "isnan", "isinf", "isfinite"}
+)
 
 
 def _expr_uniform(node, uset: set[str], private: set[str] = frozenset()) -> bool:
@@ -1083,6 +1141,9 @@ def _expr_uniform(node, uset: set[str], private: set[str] = frozenset()) -> bool
             return False
         if name in _UNIFORM_CALLS:
             return True
+        if name not in _PURE_BUILTINS:
+            # user helpers (and anything unrecognized) may read lane state
+            return False
         return all(_expr_uniform(a, uset, private) for a in node.args)
     return False  # unknown node kind: be conservative
 
@@ -1339,6 +1400,7 @@ def build_kernel_fn(
     def fn(offset, arrays: tuple, values: tuple = ()):
         ctx = _Ctx(chunk, jnp.asarray(offset, jnp.int32), global_size, local_size, {})
         ctx.uniform_vars = uniform
+        ctx.helpers = getattr(kernel, "helpers", {}) or {}
         for p, arr in zip(array_params, arrays):
             ctx.bufs[p.name] = arr
             ctx.buf_ctypes[p.name] = p.ctype

@@ -240,6 +240,13 @@ class Return(Node):
 
 
 @dataclass
+class ReturnValue(Node):
+    """``return expr;`` — only valid as the LAST statement of a helper."""
+
+    value: Any = None
+
+
+@dataclass
 class Break(Node):
     pass
 
@@ -259,11 +266,26 @@ class Param(Node):
 
 
 @dataclass
+class FuncDef(Node):
+    """A non-kernel helper function (scalar params, scalar return);
+    inlined at call sites by the codegen."""
+
+    name: str
+    ret_ctype: str = "float"
+    params: list[Param] = field(default_factory=list)
+    body: list = field(default_factory=list)
+
+
+@dataclass
 class KernelDef(Node):
     name: str
     params: list[Param] = field(default_factory=list)
     body: list = field(default_factory=list)
     source: str = ""
+    # helper functions defined in the same source, by name (inlined at
+    # call sites — the concept behind the reference's unimplemented
+    # ClBuiltInAuxilliaryFunctions, ClBuiltInAuxilliaryFunctions.cs:27-46)
+    helpers: dict = field(default_factory=dict)
 
 
 # ---------------------------------------------------------------------------
@@ -284,6 +306,7 @@ class _Parser:
         self.i = 0
         self.source = source
         self._loop_depth = 0  # break/continue outside a loop = parse error
+        self._in_helper = False  # `return expr;` only valid in helpers
 
     # -- token helpers ------------------------------------------------------
     @property
@@ -336,9 +359,60 @@ class _Parser:
         }
         return norm.get(t, t)
 
+    def parse_helper(self, start: Token) -> FuncDef:
+        """A non-kernel function: scalar params, scalar return, inlined at
+        call sites.  Exactly one ``return expr;`` — the last statement."""
+        ret = self.parse_type()
+        if ret == "void":
+            raise KernelLanguageError(
+                "helper functions must return a value (kernels are the "
+                "only void functions)", line=start.line,
+            )
+        name_tok = self.advance()
+        if name_tok.kind != "id":
+            raise self.err(f"expected function name, found {name_tok.text!r}", name_tok.line)
+        params = self.parse_params()
+        for p in params:
+            if p.is_pointer:
+                raise KernelLanguageError(
+                    f"helper {name_tok.text!r}: pointer parameters are not "
+                    "supported — pass array elements by value", line=start.line,
+                )
+        self.expect("{")
+        saved_h, saved_d = self._in_helper, self._loop_depth
+        self._in_helper, self._loop_depth = True, 0
+        try:
+            body = self.parse_block_items()
+        finally:
+            self._in_helper, self._loop_depth = saved_h, saved_d
+        self.expect("}")
+
+        def count_returns(stmts) -> int:
+            n = 0
+            for st in stmts:
+                if isinstance(st, ReturnValue):
+                    n += 1
+                elif isinstance(st, If):
+                    n += count_returns(st.then) + count_returns(st.other)
+                elif isinstance(st, For):
+                    n += count_returns(st.body)
+                elif isinstance(st, (While, DoWhile)):
+                    n += count_returns(st.body)
+            return n
+
+        if count_returns(body) != 1 or not body or not isinstance(body[-1], ReturnValue):
+            raise KernelLanguageError(
+                f"helper {name_tok.text!r} must have exactly one 'return "
+                "expr;' as its final statement (early returns: use a local "
+                "and an if-guard)", line=start.line,
+            )
+        return FuncDef(name=name_tok.text, ret_ctype=ret, params=params,
+                       body=body, line=start.line)
+
     # -- top level ----------------------------------------------------------
     def parse_program(self) -> list[KernelDef]:
         kernels: list[KernelDef] = []
+        helpers: dict = {}
         while self.cur.kind != "eof":
             start = self.cur
             is_kernel = False
@@ -346,13 +420,14 @@ class _Parser:
                 is_kernel = True
                 self.advance()
             if not is_kernel:
-                # non-kernel helper functions are not yet supported; skip
-                # top-level junk until we find a kernel or eof
-                raise self.err(
-                    f"only __kernel functions are supported at top level "
-                    f"(found {start.text!r}); helper functions must be inlined",
-                    line=start.line,
-                )
+                helpers_def = self.parse_helper(start)
+                if helpers_def.name in helpers:
+                    raise KernelLanguageError(
+                        f"helper {helpers_def.name!r} redefined",
+                        line=helpers_def.line,
+                    )
+                helpers[helpers_def.name] = helpers_def
+                continue
             ret = self.parse_type()
             if ret != "void":
                 raise KernelLanguageError(
@@ -367,7 +442,7 @@ class _Parser:
             self.expect("}")
             kernels.append(
                 KernelDef(name=name_tok.text, params=params, body=body,
-                          source=self.source, line=start.line)
+                          source=self.source, helpers=helpers, line=start.line)
             )
         if not kernels:
             raise self.err("no __kernel functions found in source")
@@ -442,6 +517,10 @@ class _Parser:
                 return self.parse_do()
             if t.text == "return":
                 self.advance()
+                if self._in_helper:
+                    expr = self.parse_expr()
+                    self.expect(";")
+                    return ReturnValue(value=expr, line=t.line)
                 if not self.accept(";"):
                     raise KernelLanguageError("kernels are void; 'return value;' unsupported", line=t.line)
                 return Return(line=t.line)

@@ -35,6 +35,7 @@ from cekirdekler_tpu.kernel.lang import (
     KernelDef,
     Num,
     Return,
+    ReturnValue,
     Ternary,
     UnOp,
     Var,
@@ -303,6 +304,18 @@ class Oracle:
     def _call(self, node: Call, state):
         env, priv, ctypes, arrays, gid, gsize = state
         name = node.name
+        helpers = getattr(self.kernel, "helpers", {}) or {}
+        if name in helpers:
+            fdef = helpers[name]
+            vals = [self._expr(a, state) for a in node.args]
+            henv = {
+                p.name: _NPT[p.ctype](v) for p, v in zip(fdef.params, vals)
+            }
+            hctypes = {p.name: p.ctype for p in fdef.params}
+            hstate = (henv, {}, hctypes, {}, gid, gsize)  # no buffer access
+            self._block(fdef.body[:-1], hstate)
+            assert isinstance(fdef.body[-1], ReturnValue)
+            return _NPT[fdef.ret_ctype](self._expr(fdef.body[-1].value, hstate))
         if name.startswith(("native_", "half_")):
             name = name.split("_", 1)[1]
         args = [self._expr(a, state) for a in node.args]

@@ -285,3 +285,121 @@ def test_oracle_divergent_break_poisons_uniform_gather():
         "w": rng.standard_normal(N).astype(np.float32),
         "out": np.zeros(N, np.float32),
     }, {})
+
+
+def test_oracle_helper_functions():
+    """Non-kernel helper functions inline at call sites: scalar params,
+    locals, loops inside the helper, nested helper calls."""
+    src = """
+    float sq(float v) {
+        return v * v;
+    }
+    float powsum(float base, int n) {
+        float acc = 0.0f;
+        float p = 1.0f;
+        for (int k = 0; k < n; k++) {
+            p = p * base;
+            acc = acc + sq(p);
+        }
+        return acc;
+    }
+    __kernel void k(__global float* x, __global float* out) {
+        int i = get_global_id(0);
+        out[i] = powsum(x[i] * 0.5f, 4) + sq(x[i]);
+    }"""
+    rng = np.random.default_rng(21)
+    _run_both(src, {
+        "x": rng.standard_normal(N).astype(np.float32),
+        "out": np.zeros(N, np.float32),
+    }, {})
+
+
+def test_oracle_helper_under_divergent_branch():
+    src = """
+    float pick(float a, float b) {
+        float r = a;
+        if (b > a) {
+            r = b;
+        }
+        return r;
+    }
+    __kernel void k(__global float* x, __global float* out) {
+        int i = get_global_id(0);
+        if (x[i] > 0.0f) {
+            out[i] = pick(x[i], 2.0f);
+        } else {
+            out[i] = pick(-x[i], 1.0f) * 0.5f;
+        }
+    }"""
+    rng = np.random.default_rng(22)
+    _run_both(src, {
+        "x": rng.standard_normal(N).astype(np.float32),
+        "out": np.zeros(N, np.float32),
+    }, {})
+
+
+def test_oracle_helper_scoping_regressions():
+    """Helpers must not see caller buffers, caller private arrays, or
+    inherit kernel uniformity facts for same-named locals (review-found
+    miscompilations)."""
+    import pytest as _pytest
+
+    from cekirdekler_tpu.errors import KernelCompileError, KernelLanguageError
+
+    # same-named helper local must not inherit kernel-level uniformity
+    src = """
+    int tri(int idx) {
+        int u = idx * (idx + 1) / 2;
+        return u;
+    }
+    __kernel void k(__global float* x, __global float* out, int base) {
+        int i = get_global_id(0);
+        int u = base;
+        out[i] = x[tri(i) % 8 + u];
+    }"""
+    rng = np.random.default_rng(31)
+    _run_both(src, {
+        "x": rng.standard_normal(N).astype(np.float32),
+        "out": np.zeros(N, np.float32),
+    }, {"base": 3})
+
+    # helper param may shadow a caller private array's name
+    src2 = """
+    float pick(float w) {
+        return w * 2.0f;
+    }
+    __kernel void k(__global float* x, __global float* out) {
+        int i = get_global_id(0);
+        float w[2];
+        w[0] = x[i];
+        out[i] = pick(w[0]);
+    }"""
+    _run_both(src2, {
+        "x": rng.standard_normal(N).astype(np.float32),
+        "out": np.zeros(N, np.float32),
+    }, {})
+
+    # buffer access inside a helper is rejected (documented contract)
+    src3 = """
+    float bad(float v) {
+        float t = q[0];
+        return v + t;
+    }
+    __kernel void k(__global float* q, __global float* out) {
+        int i = get_global_id(0);
+        out[i] = bad(q[i]);
+    }"""
+    from cekirdekler_tpu.kernel import codegen as _cg, lang as _lang
+
+    kdef = _lang.parse_kernels(src3)[0]
+    fn, _ = _cg.build_kernel_fn(kdef, N, 64, N)
+    with _pytest.raises((KernelCompileError, KernelLanguageError)):
+        fn(0, (jnp.zeros(N, jnp.float32), jnp.zeros(N, jnp.float32)), ())
+
+    # duplicate helper definition is a parse error
+    with _pytest.raises(KernelLanguageError):
+        _lang.parse_kernels(
+            "float f(float v){ return v; }\n"
+            "float f(float v){ return v + 1.0f; }\n"
+            "__kernel void k(__global float* a){}"
+        )
