@@ -111,6 +111,7 @@ def _probe(kernel: lang.KernelDef, rows: int, local_size: int, global_size: int)
 
     def run(offset, arrays, values):
         ctx = _PallasCtx(rows, offset, global_size, local_size, {})
+        ctx.helpers = getattr(kernel, "helpers", {}) or {}
         for p, arr in zip(array_params, arrays):
             ctx.bufs[p.name] = arr
             ctx.buf_ctypes[p.name] = p.ctype
@@ -146,6 +147,7 @@ def _tile_kernel(kernel: lang.KernelDef, rows: int, local_size: int,
         out_refs = refs[1 + n_vals + len(array_params) :]
         base = offset_ref[0, 0] + pl_program_id() * rows * LANES
         ctx = _PallasCtx(rows, base, global_size, local_size, {})
+        ctx.helpers = getattr(kernel, "helpers", {}) or {}
         for p, r in zip(array_params, in_refs):
             ctx.bufs[p.name] = r[:]
             ctx.buf_ctypes[p.name] = p.ctype

@@ -49,6 +49,18 @@ def _gen_expr(rng, depth, vars_):
 
 def _gen_kernel(seed: int) -> str:
     rng = np.random.default_rng(seed)
+    # optionally route one subexpression through an inlined helper
+    use_helper = bool(rng.integers(0, 2))
+    helper = (
+        "float hmix(float p, float q) {\n"
+        "    float r = p * 0.5f;\n"
+        "    if (q > 0.0f) {\n"
+        "        r = r + q * 0.25f;\n"
+        "    }\n"
+        "    return r;\n"
+        "}\n"
+        if use_helper else ""
+    )
     body = ["int i = get_global_id(0);",
             "float x = a[i];", "float y = b[i];"]
     vars_ = ["x", "y"]
@@ -56,24 +68,36 @@ def _gen_kernel(seed: int) -> str:
     for v in ("t0", "t1"):
         body.append(f"float {v} = {_gen_expr(rng, 3, vars_)};")
         vars_.append(v)
+    if use_helper:
+        body.append(f"float th = hmix({_gen_expr(rng, 2, vars_)}, y);")
+        vars_.append("th")
     # a branch
     body.append(
         f"if ({_gen_expr(rng, 2, vars_)} > 0.0f) {{"
         f" t0 = {_gen_expr(rng, 2, vars_)}; }}"
         f" else {{ t1 = {_gen_expr(rng, 2, vars_)}; }}"
     )
-    # a bounded loop with an accumulator (trip count varies per lane)
+    # a bounded loop with an accumulator (trip count varies per lane),
+    # optionally with divergent break/continue
     trips = int(rng.integers(2, 6))
+    exit_kind = int(rng.integers(0, 3))  # 0: none, 1: break, 2: continue
     body.append("float acc = t0;")
     body.append("int k = 0;")
+    loop_body = f" acc = acc * 0.5f + {_gen_expr(rng, 2, vars_)} * 0.25f;"
+    if exit_kind == 1:
+        loop_body += " if (acc > 2.0f) { break; }"
+    elif exit_kind == 2:
+        loop_body += " k = k + 1; if (acc < 0.0f) { acc = acc + 0.125f; continue; }"
+    if exit_kind != 2:
+        loop_body += " k = k + 1;"
     body.append(
-        f"while (k < {trips} && fabs(acc) < 50.0f) {{"
-        f" acc = acc * 0.5f + {_gen_expr(rng, 2, vars_)} * 0.25f; k = k + 1; }}"
+        f"while (k < {trips} && fabs(acc) < 50.0f) {{{loop_body} }}"
     )
     body.append("out[i] = acc + t1;")
     inner = "\n        ".join(body)
     return (
-        "__kernel void fz(__global float* a, __global float* b, "
+        helper
+        + "__kernel void fz(__global float* a, __global float* b, "
         "__global float* out) {\n        " + inner + "\n}"
     )
 
