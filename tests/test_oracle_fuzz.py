@@ -403,3 +403,48 @@ def test_oracle_helper_scoping_regressions():
             "float f(float v){ return v + 1.0f; }\n"
             "__kernel void k(__global float* a){}"
         )
+
+
+@pytest.mark.parametrize("seed", range(8, 14))
+def test_oracle_random_control_flow_kernels(seed):
+    """Randomized kernels mixing helpers, break/continue, private arrays,
+    and gathers — full-language oracle fuzzing."""
+    rng = np.random.default_rng(100 + seed)
+    trips = int(rng.integers(3, 9))
+    thresh = float(rng.uniform(0.5, 3.0))
+    karr = int(rng.integers(2, 5))
+    src = f"""
+    float fold(float a, float b) {{
+        float r = a * 0.5f + b * 0.25f;
+        if (r > {thresh}f) {{
+            r = r - {thresh}f;
+        }}
+        return r;
+    }}
+    __kernel void k(__global int* idx, __global float* x, __global float* out) {{
+        int i = get_global_id(0);
+        float t[{karr}];
+        for (int j = 0; j < {karr}; j++) {{
+            t[j] = x[idx[i] + j] * 0.5f;
+        }}
+        float acc = 0.0f;
+        int n = 0;
+        while (n < {trips}) {{
+            n = n + 1;
+            float c = fold(acc, t[n % {karr}]);
+            if (c < 0.0f) {{
+                acc = acc + 0.25f;
+                continue;
+            }}
+            acc = c + x[i] * 0.125f;
+            if (acc > {thresh * 2}f) {{
+                break;
+            }}
+        }}
+        out[i] = acc + t[0];
+    }}"""
+    _run_both(src, {
+        "idx": rng.integers(0, N, N).astype(np.int32),
+        "x": rng.standard_normal(N).astype(np.float32),
+        "out": np.zeros(N, np.float32),
+    }, {})
