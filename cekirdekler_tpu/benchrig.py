@@ -31,7 +31,7 @@ def convergence_sim(ndev: int = 8, step: int = 256) -> dict:
     escape counts), which is what a chip's wall time measures on real
     isolated hardware.  Same code path as production: equal_split →
     load_balance with history smoothing + continuous carry."""
-    from .core.balance import BalanceHistory, equal_split, load_balance
+    from .core.balance import BalanceHistory, BalanceState, equal_split, load_balance
     from .workloads import _converged_at, mandelbrot_host
 
     w = h = 512
@@ -41,20 +41,27 @@ def convergence_sim(ndev: int = 8, step: int = 256) -> dict:
     cum = np.concatenate([[0.0], np.cumsum(cost)])
     n = w * h
 
-    def run(smooth: bool):
+    def run(smooth: bool, adaptive: bool = True):
+        """Same config Cores._ranges_for uses: adaptive BalanceState +
+        recency-weighted history by default; adaptive=False is the
+        reference-parity fixed-damping mode."""
         ranges = equal_split(n, ndev, step)
-        hist = BalanceHistory() if smooth else None
-        carry: list[float] = []
+        hist = BalanceHistory(weighted=adaptive) if smooth else None
+        state = BalanceState() if adaptive else None
+        carry: list[float] | None = None if adaptive else []
         traj = [list(ranges)]
         for _ in range(48):
             offs = np.concatenate([[0], np.cumsum(ranges)]).astype(int)
             bench = [float(cum[offs[i + 1]] - cum[offs[i]]) for i in range(ndev)]
-            ranges = load_balance(bench, ranges, n, step, hist, carry=carry)
+            ranges = load_balance(bench, ranges, n, step, hist,
+                                  carry=carry, state=state)
             traj.append(list(ranges))
         return traj
 
     traj = run(smooth=True)
     traj_ns = run(smooth=False)
+    traj_parity = run(smooth=True, adaptive=False)
+    traj_parity_ns = run(smooth=False, adaptive=False)
 
     # balanced quality: max per-chip work / mean, at first vs final split
     def imbalance(r):
@@ -65,8 +72,14 @@ def convergence_sim(ndev: int = 8, step: int = 256) -> dict:
     return {
         "n_devices": ndev,
         "iterations_run": len(traj) - 1,
+        # smoothed/unsmoothed run the PRODUCTION config (adaptive damping);
+        # the *_reference_parity keys rerun both with the fixed-0.3-damping
+        # parity mode so cross-round comparisons against r3 numbers (which
+        # predate adaptive damping) have a like-for-like column
         "convergence_iters_smoothed": _converged_at(traj, step),
         "convergence_iters_unsmoothed": _converged_at(traj_ns, step),
+        "convergence_iters_smoothed_reference_parity": _converged_at(traj_parity, step),
+        "convergence_iters_unsmoothed_reference_parity": _converged_at(traj_parity_ns, step),
         "imbalance_first": round(imbalance(traj[0]), 3),
         "imbalance_final": round(imbalance(traj[-1]), 3),
         "imbalance_final_unsmoothed": round(imbalance(traj_ns[-1]), 3),

@@ -1,10 +1,11 @@
-from .balance import BalanceHistory, equal_split, load_balance
+from .balance import BalanceHistory, BalanceState, equal_split, load_balance
 from .cores import PIPELINE_DRIVER, PIPELINE_EVENT, ComputePerf, Cores
 from .cruncher import NumberCruncher
 from .worker import Worker
 
 __all__ = [
     "BalanceHistory",
+    "BalanceState",
     "ComputePerf",
     "Cores",
     "NumberCruncher",

@@ -24,18 +24,37 @@ from __future__ import annotations
 
 from dataclasses import dataclass, field
 
-__all__ = ["load_balance", "BalanceHistory", "equal_split", "DAMPING", "HISTORY_DEPTH"]
+__all__ = [
+    "load_balance",
+    "BalanceHistory",
+    "BalanceState",
+    "equal_split",
+    "DAMPING",
+    "HISTORY_DEPTH",
+]
 
 DAMPING = 0.3        # reference: HelperFunctions.cs:246
 HISTORY_DEPTH = 10   # reference: Cores.cs:1065
+DAMP_MIN = 0.05      # adaptive floor — keeps the balancer responsive
+DAMP_MAX = 0.6       # adaptive ceiling — faster than reference warm-up
+DAMP_MAX_SMOOTHED = 0.3  # ceiling when a lagging history smoother is in the loop
+DAMP_DECAY = 0.5     # on sign flip (oscillation detected)
+DAMP_GROW = 1.25     # on consistent direction
 
 
 @dataclass
 class BalanceHistory:
-    """Sliding-window share smoothing (reference: HelperFunctions.cs:119-156)."""
+    """Sliding-window share smoothing (reference: HelperFunctions.cs:119-156).
+
+    ``weighted=False`` is the reference-parity flat average (group delay
+    ≈ (depth−1)/2 ≈ 4.5 iterations).  ``weighted=True`` applies triangular
+    recency weights — same noise suppression class, ~2/3 the lag — which is
+    what lets the adaptive damping converge fast *with* smoothing on.
+    """
 
     depth: int = HISTORY_DEPTH
     rows: list[list[float]] = field(default_factory=list)
+    weighted: bool = False
 
     def smooth(self, shares: list[float]) -> list[float]:
         if self.rows and len(self.rows[0]) != len(shares):
@@ -45,11 +64,39 @@ class BalanceHistory:
             self.rows.pop(0)
         n = len(shares)
         out = [0.0] * n
-        for row in self.rows:
+        tot_w = 0.0
+        for k, row in enumerate(self.rows, start=1):
+            w = float(k) if self.weighted else 1.0
+            tot_w += w
             for i in range(n):
-                out[i] += row[i]
-        cnt = len(self.rows)
-        return [v / cnt for v in out]
+                out[i] += w * row[i]
+        return [v / tot_w for v in out]
+
+
+@dataclass
+class BalanceState:
+    """Per-compute-id continuous balancer state with *adaptive* per-chip
+    damping.
+
+    The reference uses one fixed damping 0.3 (HelperFunctions.cs:246).
+    Near the fixed point that constant gain limit-cycles: a one-step
+    quantization error on a low-cost-density chip perturbs its measured
+    bench, and the share formula ``(Σb/b_i)·(range_i+1)`` scales that
+    perturbation by the chip's (large) range — a loop gain > 1 that keeps
+    ranges hopping ±2-4 steps forever.  RPROP-style per-chip damping kills
+    the cycle: when a chip's desired move flips sign its damping halves
+    (oscillation), while consistent direction grows it up to ``DAMP_MAX``
+    (faster warm-up than the reference's fixed 0.3).
+    """
+
+    cont: list[float] = field(default_factory=list)
+    prev_delta: list[float] = field(default_factory=list)
+    damp: list[float] = field(default_factory=list)
+
+    def reset(self, ranges: list[int], damping: float) -> None:
+        self.cont = [float(r) for r in ranges]
+        self.prev_delta = [0.0] * len(ranges)
+        self.damp = [damping] * len(ranges)
 
 
 def equal_split(total: int, num: int, step: int) -> list[int]:
@@ -74,6 +121,7 @@ def load_balance(
     history: BalanceHistory | None = None,
     damping: float = DAMPING,
     carry: list[float] | None = None,
+    state: BalanceState | None = None,
 ) -> list[int]:
     """One balancer iteration; returns new per-chip ranges summing to
     ``total``, each a multiple of ``step`` (≥ 0).
@@ -83,6 +131,11 @@ def load_balance(
     array, so any damped move smaller than step/2 rounds back and the
     balancer stalls up to ~2 steps from the ideal split; carrying the
     continuous state lets sub-step moves accumulate and converge exactly.
+
+    ``state`` — optional :class:`BalanceState` enabling *adaptive* per-chip
+    damping (supersedes ``carry``; see the class docstring).  Passing
+    neither, or only ``carry``, keeps the reference's fixed-damping
+    behavior (HelperFunctions.cs:246) as the parity mode.
     """
     n = len(ranges)
     if n == 1:
@@ -91,9 +144,15 @@ def load_balance(
         ranges = equal_split(total, n, step)
         if carry is not None:
             carry.clear()
+        if state is not None:
+            state.cont.clear()
+    if state is not None and len(state.cont) != n:
+        state.reset(ranges, damping)
 
     base: list[float]
-    if carry:
+    if state is not None:
+        base = list(state.cont)
+    elif carry:
         base = list(carry)
     else:
         base = [float(r) for r in ranges]
@@ -101,6 +160,28 @@ def load_balance(
     # 1-2: normalized throughput shares (measured on the quantized ranges)
     safe = [max(b, 1e-9) for b in benchmarks]
     tot_b = sum(safe)
+
+    # adaptive mode: quantization-floor freeze.  When the busiest chip's
+    # excess over the mean is less than ~half the work one ``step`` of its
+    # range represents, no step-quantized move can improve the balance —
+    # further moves just churn (re-shard, re-upload) around a ±1-step limit
+    # cycle.  Hold the split and re-anchor the continuous state.
+    if (
+        state is not None
+        and len(state.cont) == n
+        # holding is only legal when the held split is valid for the
+        # caller's CURRENT step (pipeline mode changes step to
+        # local_range·blobs mid-stream, Cores.cs:595-604)
+        and all(r % step == 0 for r in ranges)
+    ):
+        mean_b = tot_b / n
+        i_max = max(range(n), key=lambda k: safe[k])
+        if ranges[i_max] > 0:
+            one_step_work = safe[i_max] / ranges[i_max] * step
+            if safe[i_max] - mean_b < 0.6 * one_step_work:
+                state.cont = [float(r) for r in ranges]
+                state.prev_delta = [0.0] * n
+                return list(ranges)
     thr = [(tot_b / safe[i]) * (ranges[i] + 1.0) for i in range(n)]
     tot_t = sum(thr)
     shares = [t / tot_t for t in thr]
@@ -112,7 +193,28 @@ def load_balance(
         shares = [v / s for v in shares]
 
     # 4: damped continuous update
-    cont = [base[i] - (base[i] - total * shares[i]) * damping for i in range(n)]
+    if state is not None:
+        # a lagging smoother in the loop lowers the stable gain ceiling
+        # (delay ~3 iters × gain must stay < 1): cap tighter when history on
+        damp_max = DAMP_MAX if history is None else DAMP_MAX_SMOOTHED
+        cont = []
+        for i in range(n):
+            delta = total * shares[i] - base[i]
+            if delta * state.prev_delta[i] < 0.0:
+                state.damp[i] = max(DAMP_MIN, state.damp[i] * DAMP_DECAY)
+            elif delta * state.prev_delta[i] > 0.0:
+                state.damp[i] = min(damp_max, state.damp[i] * DAMP_GROW)
+            state.damp[i] = min(state.damp[i], damp_max)
+            state.prev_delta[i] = delta
+            cont.append(base[i] + delta * state.damp[i])
+        # unequal per-chip damping breaks Σcont == total; renormalize so
+        # drift can't accumulate across iterations
+        s = sum(cont)
+        if s > 0:
+            cont = [c * (total / s) for c in cont]
+        state.cont = list(cont)
+    else:
+        cont = [base[i] - (base[i] - total * shares[i]) * damping for i in range(n)]
     if carry is not None:
         carry[:] = cont
 

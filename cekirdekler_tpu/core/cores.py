@@ -36,7 +36,7 @@ from ..arrays.clarray import ClArray
 from ..errors import ComputeValidationError
 from ..hardware import Devices
 from ..kernel.registry import KernelProgram
-from .balance import BalanceHistory, equal_split, load_balance
+from .balance import BalanceHistory, BalanceState, equal_split, load_balance
 from .worker import Worker
 
 __all__ = ["Cores", "PIPELINE_EVENT", "PIPELINE_DRIVER", "ComputePerf"]
@@ -79,7 +79,9 @@ class Cores:
         self.global_ranges: dict[int, list[int]] = {}
         self.global_references: dict[int, list[int]] = {}
         self.histories: dict[int, BalanceHistory] = {}
-        self._cont_ranges: dict[int, list[float]] = {}  # continuous balancer state
+        self._balance_states: dict[int, BalanceState] = {}  # adaptive balancer state
+        self._adaptive_load_balancer = True
+        self._cont_ranges: dict[int, list[float]] = {}  # continuous state (parity mode)
         self.perf: dict[int, ComputePerf] = {}
         # rolling perf records per compute id (reference keeps only the
         # last report, Cores.cs:994-1063; we keep a queryable history)
@@ -109,6 +111,24 @@ class Cores:
         # lane blocks on the event before its compute phase, so triggering
         # starts all lanes simultaneously
         self.dispatch_gate = None
+
+    @property
+    def adaptive_load_balancer(self) -> bool:
+        """Adaptive per-chip damping (:class:`BalanceState`) — the default.
+        Setting ``False`` restores the reference's fixed 0.3 damping + flat
+        history window (HelperFunctions.cs:246) exactly; toggling either way
+        clears the per-compute-id balancer state so the two modes never feed
+        each other stale continuous ranges or mis-weighted history rows."""
+        return self._adaptive_load_balancer
+
+    @adaptive_load_balancer.setter
+    def adaptive_load_balancer(self, v: bool) -> None:
+        v = bool(v)
+        if v != self._adaptive_load_balancer:
+            self._adaptive_load_balancer = v
+            self.histories.clear()
+            self._balance_states.clear()
+            self._cont_ranges.clear()
 
     @property
     def num_devices(self) -> int:
@@ -142,9 +162,16 @@ class Cores:
             if all(b > 0 for b in bench):
                 hist = None
                 if self.smooth_load_balancer:
-                    hist = self.histories.setdefault(compute_id, BalanceHistory())
-                carry = self._cont_ranges.setdefault(compute_id, [])
-                ranges = load_balance(bench, ranges, total, step, hist, carry=carry)
+                    hist = self.histories.setdefault(
+                        compute_id,
+                        BalanceHistory(weighted=self.adaptive_load_balancer),
+                    )
+                if self.adaptive_load_balancer:
+                    state = self._balance_states.setdefault(compute_id, BalanceState())
+                    ranges = load_balance(bench, ranges, total, step, hist, state=state)
+                else:
+                    carry = self._cont_ranges.setdefault(compute_id, [])
+                    ranges = load_balance(bench, ranges, total, step, hist, carry=carry)
         self.global_ranges[compute_id] = ranges
         refs = [0] * n
         acc = 0

@@ -116,6 +116,16 @@ class NumberCruncher:
         self.cores.smooth_load_balancer = bool(v)
 
     @property
+    def adaptive_load_balancer(self) -> bool:
+        """Adaptive per-chip balancer damping (default True); False =
+        reference-parity fixed 0.3 damping (HelperFunctions.cs:246)."""
+        return self.cores.adaptive_load_balancer
+
+    @adaptive_load_balancer.setter
+    def adaptive_load_balancer(self, v: bool) -> None:
+        self.cores.adaptive_load_balancer = bool(v)
+
+    @property
     def repeat_count(self) -> int:
         return self.cores.repeat_count
 

@@ -5,7 +5,10 @@ import numpy as np
 import pytest
 
 from cekirdekler_tpu.core.balance import (
+    DAMP_MAX,
+    DAMP_MAX_SMOOTHED,
     BalanceHistory,
+    BalanceState,
     equal_split,
     load_balance,
 )
@@ -115,3 +118,132 @@ def test_history_resets_on_device_count_change():
     hist.smooth([0.5, 0.5])
     out = hist.smooth([0.2, 0.3, 0.5])
     assert len(out) == 3
+
+
+# -- adaptive damping (BalanceState) -----------------------------------------
+
+def _mandelbrot_cost_field():
+    from cekirdekler_tpu.workloads import mandelbrot_host
+
+    w = h = 256
+    img = mandelbrot_host(w, h, -2.0, -1.25, 2.5 / w, 2.5 / h, 96)
+    cost = img.astype(np.float64) + 2.0
+    return np.concatenate([[0.0], np.cumsum(cost)]), w * h
+
+
+def _run_sim(total, cum, ndev, step, iters, hist=None, state=None, carry=None):
+    ranges = equal_split(total, ndev, step)
+    traj = [list(ranges)]
+    for _ in range(iters):
+        offs = np.concatenate([[0], np.cumsum(ranges)]).astype(int)
+        bench = [float(cum[offs[i + 1]] - cum[offs[i]]) for i in range(ndev)]
+        ranges = load_balance(bench, ranges, total, step, hist,
+                              carry=carry, state=state)
+        traj.append(list(ranges))
+    return traj
+
+
+def test_adaptive_state_settles_without_limit_cycle():
+    # fixed damping limit-cycles +-2-4 steps forever on the skewed
+    # mandelbrot cost field; the adaptive state must come fully to rest
+    cum, total = _mandelbrot_cost_field()
+    step = 128
+    traj = _run_sim(total, cum, 8, step, 40, state=BalanceState())
+    tail = traj[-8:]
+    assert all(t == tail[0] for t in tail), "ranges still moving at the tail"
+    assert sum(tail[0]) == total
+
+
+def test_adaptive_converges_faster_than_parity():
+    from cekirdekler_tpu.workloads import _converged_at
+
+    cum, total = _mandelbrot_cost_field()
+    step = 128
+    t_adapt = _run_sim(total, cum, 8, step, 48, hist=BalanceHistory(weighted=True),
+                       state=BalanceState())
+    t_parity = _run_sim(total, cum, 8, step, 48, hist=BalanceHistory(), carry=[])
+    ca = _converged_at(t_adapt, step)
+    cp = _converged_at(t_parity, step)
+    assert ca is not None and ca < 25
+    assert cp is None or ca < cp
+
+
+def test_adaptive_damp_decays_on_oscillation_and_respects_caps():
+    state = BalanceState()
+    ranges = [512, 512]
+    # alternate which chip looks slow -> every move flips sign
+    for k in range(12):
+        bench = [1.0, 2.0] if k % 2 == 0 else [2.0, 1.0]
+        ranges = load_balance(bench, ranges, 1024, 64, state=state)
+    assert all(d <= DAMP_MAX for d in state.damp)
+    assert any(d < 0.3 for d in state.damp), "sign flips must decay damping"
+    # smoothed cap is tighter
+    state2 = BalanceState()
+    hist = BalanceHistory(weighted=True)
+    ranges = [768, 256]
+    for _ in range(20):
+        bench = [4.0, 1.0]  # consistent direction -> damp grows to the cap
+        ranges = load_balance(bench, ranges, 1024, 64, hist, state=state2)
+    assert all(d <= DAMP_MAX_SMOOTHED for d in state2.damp)
+
+
+def test_adaptive_state_resets_on_device_count_change():
+    state = BalanceState()
+    load_balance([1.0, 2.0], [512, 512], 1024, 64, state=state)
+    out = load_balance([1.0, 2.0, 3.0], [512, 256, 256], 1024, 64, state=state)
+    assert len(out) == 3 and sum(out) == 1024
+    assert len(state.cont) == 3
+
+
+def test_weighted_history_weights_recent_rows_more():
+    flat = BalanceHistory()
+    tri = BalanceHistory(weighted=True)
+    rows = [[0.9, 0.1]] * 5 + [[0.1, 0.9]]
+    for r in rows:
+        f = flat.smooth(list(r))
+        t = tri.smooth(list(r))
+    # triangular puts more weight on the last (flipped) row
+    assert t[1] > f[1]
+
+
+def test_adaptive_freeze_requantizes_on_step_change():
+    # converge at step 64, then call with step 256 (pipeline mode changes
+    # step to local*blobs): the freeze must not hold a 64-grain split that
+    # is invalid for the new step
+    state = BalanceState()
+    ranges = [448, 576]  # multiples of 64, not of 256
+    out = load_balance([1.0, 1.0], ranges, 1024, 256, state=state)
+    assert all(r % 256 == 0 for r in out)
+    assert sum(out) == 1024
+
+
+def test_cores_adaptive_toggle_clears_balancer_state():
+    from cekirdekler_tpu.core.cores import Cores  # noqa: F401 (import check)
+    from cekirdekler_tpu.core import NumberCruncher
+    from cekirdekler_tpu.hardware import platforms
+
+    src = """
+    __kernel void t(__global float* a) {
+        int i = get_global_id(0);
+        a[i] = a[i] + 1.0f;
+    }
+    """
+    cr = NumberCruncher(platforms().cpus().subset(2), src)
+    try:
+        a_ = np.zeros(512, np.float32)
+        from cekirdekler_tpu import ClArray
+        a = ClArray(512, np.float32, name="tgl", read=True, write=True)
+        for _ in range(3):
+            a.compute(cr, 5, "t", 512, 64)
+        assert cr.cores._balance_states  # adaptive state accumulated
+        cr.adaptive_load_balancer = False
+        assert not cr.cores._balance_states
+        assert not cr.cores.histories
+        for _ in range(2):
+            a.compute(cr, 5, "t", 512, 64)
+        hist = cr.cores.histories.get(5)
+        assert hist is None or hist.weighted is False  # parity-mode history
+        cr.adaptive_load_balancer = True
+        assert not cr.cores.histories and not cr.cores._cont_ranges
+    finally:
+        cr.dispose()
