@@ -59,6 +59,11 @@ class TransferFlags:
             raise ComputeValidationError("array cannot be read_only and write_only")
         if self.elements_per_work_item < 1:
             raise ComputeValidationError("elements_per_work_item must be >= 1")
+        a = self.alignment_bytes
+        if a <= 0 or (a & (a - 1)) != 0:
+            raise ComputeValidationError(
+                f"alignment_bytes must be a power of two, got {a}"
+            )
 
     def read_write_string(self) -> str:
         """Reference-format descriptor (ClArray.cs:611-629) for debugging and
@@ -225,11 +230,16 @@ class ClArray(_ComputeMixin):
         fast: bool = False,
         **flag_overrides,
     ):
+        self.flags = TransferFlags(**flag_overrides)
+        self.flags.validate()
         if isinstance(data, (int, np.integer)):
             # auto-allocating ctor (reference: ClArray.cs:809-846)
             n = int(data)
             if fast:
-                self._fast: FastArr | None = fast_arr_for_dtype(n, dtype)
+                self._check_alignment_for(np.dtype(dtype))
+                self._fast: FastArr | None = fast_arr_for_dtype(
+                    n, dtype, self.flags.alignment_bytes
+                )
                 self._np: np.ndarray | None = None
             else:
                 self._fast = None
@@ -243,10 +253,21 @@ class ClArray(_ComputeMixin):
                 arr = arr.astype(np.float32)
             self._fast = None
             self._np = np.ascontiguousarray(arr)
-        self.flags = TransferFlags(**flag_overrides)
         self.name = name or f"arr@{id(self):x}"
+        # validate against the EFFECTIVE dtype (for array data it comes from
+        # the array, not the ctor's dtype parameter) so a too-small
+        # alignment_bytes fails here as a user-input error, not later as a
+        # raw ValueError out of a fast_arr migration
+        self._check_alignment_for(self.dtype)
         # set by wrap_structs: the structured array this byte view aliases
         self._struct_source: np.ndarray | None = None
+
+    def _check_alignment_for(self, dtype: np.dtype) -> None:
+        if self.flags.alignment_bytes < dtype.itemsize:
+            raise ComputeValidationError(
+                f"alignment_bytes {self.flags.alignment_bytes} smaller than "
+                f"dtype item size {dtype.itemsize}"
+            )
 
     @classmethod
     def wrap_structs(cls, arr: np.ndarray, name: str | None = None,
@@ -287,7 +308,10 @@ class ClArray(_ComputeMixin):
         (reference: ClArray.cs:889-958)."""
         if want_native and self._fast is None:
             assert self._np is not None
-            fa = fast_arr_for_dtype(self._np.size, self._np.dtype)
+            self._check_alignment_for(self._np.dtype)
+            fa = fast_arr_for_dtype(
+                self._np.size, self._np.dtype, self.flags.alignment_bytes
+            )
             fa.copy_from(self._np)
             self._fast, self._np = fa, None
         elif not want_native and self._fast is not None:
@@ -317,7 +341,7 @@ class ClArray(_ComputeMixin):
         if n == cur.size:
             return
         if self._fast is not None:
-            fa = fast_arr_for_dtype(n, cur.dtype)
+            fa = fast_arr_for_dtype(n, cur.dtype, self._fast.alignment)
             fa.copy_from(cur[: min(n, cur.size)])
             self._fast.dispose()
             self._fast = fa
@@ -468,6 +492,7 @@ def wrap(obj: Any, **flag_overrides) -> ClArray:
     if isinstance(obj, ClArray):
         if flag_overrides:
             obj.flags = replace(obj.flags, **flag_overrides)
+            obj.flags.validate()
         return obj
     if isinstance(obj, FastArr):
         return ClArray(obj, **flag_overrides)

@@ -71,9 +71,22 @@ class FastArr:
     *no* bounds checks at all, README.md:38-40 — we keep numpy's).
     """
 
-    def __init__(self, n: int, dtype: Any):
+    def __init__(self, n: int, dtype: Any, alignment: int = ALIGNMENT):
+        """``alignment`` — allocation alignment in bytes (reference:
+        IBufferOptimization.alignmentBytes, ClArray.cs:82-149, user-settable
+        there too).  Must be a power of two ≥ the dtype's item size;
+        default stays the DMA-friendly page alignment."""
         self.dtype = np.dtype(dtype)
         self.n = int(n)
+        alignment = int(alignment)
+        if alignment <= 0 or (alignment & (alignment - 1)) != 0:
+            raise ValueError(f"alignment must be a power of two, got {alignment}")
+        if alignment < self.dtype.itemsize:
+            raise ValueError(
+                f"alignment {alignment} smaller than dtype item size "
+                f"{self.dtype.itemsize}"
+            )
+        self.alignment = alignment
         nbytes = self.n * self.dtype.itemsize
         self._nbytes = nbytes
         self._lib = _load_native()
@@ -83,7 +96,7 @@ class FastArr:
             self._backing = None
             return
         if self._lib is not None:
-            ptr = self._lib.ck_createArray(nbytes, ALIGNMENT)
+            ptr = self._lib.ck_createArray(nbytes, alignment)
             if ptr:
                 self._raw = ptr
                 buf = (ctypes.c_uint8 * nbytes).from_address(ptr)
@@ -91,7 +104,7 @@ class FastArr:
                 self._np = view.view(self.dtype)[: self.n]
                 self._backing = buf
                 return
-        view, _ = _aligned_numpy(nbytes, ALIGNMENT)
+        view, _ = _aligned_numpy(nbytes, alignment)
         self._np = view.view(self.dtype)[: self.n]
         self._backing = view
 
@@ -152,7 +165,7 @@ class FastArr:
             self._raw = None
             self._np = np.empty(0, dtype=self.dtype)
             self._backing = None
-            lib.ck_deleteArray(raw, nbytes, ALIGNMENT)
+            lib.ck_deleteArray(raw, nbytes, self.alignment)
 
     def __del__(self):  # pragma: no cover - GC timing dependent
         try:
@@ -216,5 +229,5 @@ def type_code_for_dtype(dtype) -> int:
     return _TYPE_CODES[name]
 
 
-def fast_arr_for_dtype(n: int, dtype) -> FastArr:
-    return FastArr(n, dtype)
+def fast_arr_for_dtype(n: int, dtype, alignment: int = ALIGNMENT) -> FastArr:
+    return FastArr(n, dtype, alignment)

@@ -168,7 +168,6 @@ def load_balance(
     # cycle.  Hold the split and re-anchor the continuous state.
     if (
         state is not None
-        and len(state.cont) == n
         # holding is only legal when the held split is valid for the
         # caller's CURRENT step (pipeline mode changes step to
         # local_range·blobs mid-stream, Cores.cs:595-604)

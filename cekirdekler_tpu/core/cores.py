@@ -644,7 +644,17 @@ class Cores:
         that is fed into its benchmark for every compute id dispatched since
         the last barrier, and those ids are armed to rebalance on their next
         call (sync-granularity analogue of the reference feeding event
-        benches into loadBalance, HelperFunctions.cs:190-280)."""
+        benches into loadBalance, HelperFunctions.cs:190-280).
+
+        Heuristic caveat: the whole-window fence time is assigned as the
+        bench of EVERY compute id dispatched in the window.  When kernels
+        with different per-chip cost profiles share one enqueue window,
+        each id's bench includes the others' work, so a subsequent armed
+        rebalance can misattribute cost between them.  Ids dispatched in
+        homogeneous windows (one kernel per window — the common pattern)
+        are measured exactly; mixed windows trade per-id attribution for
+        the single-RTT sync.  Callers that need exact per-id benches
+        should barrier between different kernels' dispatch runs."""
         t0 = self._enqueue_t0
         measure = self.enqueue_mode and t0 is not None and len(self.workers) > 1
         try:

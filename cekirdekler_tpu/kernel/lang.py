@@ -411,6 +411,14 @@ class _Parser:
 
     # -- top level ----------------------------------------------------------
     def parse_program(self) -> list[KernelDef]:
+        """Helpers are PROGRAM-scoped by design: every KernelDef shares the
+        one helpers dict, so a kernel may call a helper defined textually
+        after it (and helpers may call each other regardless of order).
+        This diverges from C's declaration-before-use rule — deliberately:
+        helper bodies are inlined at call sites during lowering, so textual
+        order carries no semantic weight here, and requiring forward
+        declarations would add C ceremony with no behavioral payoff.
+        Documented in docs/KERNEL_LANGUAGE.md (helper functions)."""
         kernels: list[KernelDef] = []
         helpers: dict = {}
         while self.cur.kind != "eof":

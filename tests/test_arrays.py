@@ -222,3 +222,37 @@ def test_device_partition_lanes():
         assert len(r) == 4 and sum(r) == 1024
     finally:
         cr.dispose()
+
+
+def test_fastarr_user_alignment():
+    # reference: user-settable alignmentBytes (IBufferOptimization,
+    # ClArray.cs:82-149); default stays 4096
+    for align in (64, 256, 8192):
+        fa = FastArr(100, np.float32, alignment=align)
+        assert fa.address() % align == 0
+        assert fa.alignment == align
+        fa.numpy()[:] = 7.0
+        assert float(fa.numpy().sum()) == 700.0
+        fa.dispose()
+    with pytest.raises(ValueError):
+        FastArr(10, np.float32, alignment=100)  # not a power of two
+    with pytest.raises(ValueError):
+        FastArr(10, np.float64, alignment=4)  # smaller than item size
+
+
+def test_clarray_alignment_bytes_flag_plumbed():
+    from cekirdekler_tpu import ClArray
+
+    a = ClArray(64, np.float32, fast=True, alignment_bytes=64)
+    assert a.fast_arr
+    assert a._fast.alignment == 64
+    assert a.host().ctypes.data % 64 == 0
+    # migration keeps the flag's alignment
+    b = ClArray(64, np.float32, alignment_bytes=256)
+    b.fast_arr = True
+    assert b._fast.alignment == 256
+    # resize keeps the allocation's alignment
+    b.resize(128)
+    assert b._fast.alignment == 256
+    with pytest.raises(ComputeValidationError):
+        ClArray(8, np.float32, alignment_bytes=48)
