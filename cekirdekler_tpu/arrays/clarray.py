@@ -491,8 +491,17 @@ def wrap(obj: Any, **flag_overrides) -> ClArray:
     implicit conversions, ClArray.cs:1014-1046)."""
     if isinstance(obj, ClArray):
         if flag_overrides:
-            obj.flags = replace(obj.flags, **flag_overrides)
-            obj.flags.validate()
+            # validate the candidate BEFORE assigning: a failed override
+            # must not leave the caller's (possibly still-used) array with
+            # corrupted flags
+            candidate = replace(obj.flags, **flag_overrides)
+            candidate.validate()
+            if candidate.alignment_bytes < obj.dtype.itemsize:
+                raise ComputeValidationError(
+                    f"alignment_bytes {candidate.alignment_bytes} smaller "
+                    f"than dtype item size {obj.dtype.itemsize}"
+                )
+            obj.flags = candidate
         return obj
     if isinstance(obj, FastArr):
         return ClArray(obj, **flag_overrides)

@@ -161,11 +161,18 @@ def load_balance(
     safe = [max(b, 1e-9) for b in benchmarks]
     tot_b = sum(safe)
 
+    thr = [(tot_b / safe[i]) * (ranges[i] + 1.0) for i in range(n)]
+    tot_t = sum(thr)
+    shares = [t / tot_t for t in thr]
+
     # adaptive mode: quantization-floor freeze.  When the busiest chip's
     # excess over the mean is less than ~half the work one ``step`` of its
     # range represents, no step-quantized move can improve the balance —
     # further moves just churn (re-shard, re-upload) around a ±1-step limit
-    # cycle.  Hold the split and re-anchor the continuous state.
+    # cycle.  Hold the split and re-anchor the continuous state.  The
+    # history still receives this iteration's measured shares so the
+    # smoothing window stays current — a workload shift that later
+    # unfreezes the balancer must not be steered by pre-freeze rows.
     if (
         state is not None
         # holding is only legal when the held split is valid for the
@@ -178,12 +185,11 @@ def load_balance(
         if ranges[i_max] > 0:
             one_step_work = safe[i_max] / ranges[i_max] * step
             if safe[i_max] - mean_b < 0.6 * one_step_work:
+                if history is not None:
+                    history.smooth(shares)
                 state.cont = [float(r) for r in ranges]
                 state.prev_delta = [0.0] * n
                 return list(ranges)
-    thr = [(tot_b / safe[i]) * (ranges[i] + 1.0) for i in range(n)]
-    tot_t = sum(thr)
-    shares = [t / tot_t for t in thr]
 
     # 3: optional smoothing
     if history is not None:

@@ -251,7 +251,8 @@ class Cores:
             # data another chip updated in between
             self.flush()
             for w in self.workers:
-                w.reset_coverage()
+                with w.lock:
+                    w.reset_coverage()
         # a chip whose share was quantized to zero never re-runs its bench;
         # decay its stale measurement so a one-off slow call (e.g. first-call
         # compile) cannot starve it permanently
@@ -332,6 +333,35 @@ class Cores:
         gate = self.dispatch_gate
         if gate is not None:
             gate.wait()  # synchronized start across lanes (ClUserEvent)
+        # serialize whole phases per worker: concurrent host threads driving
+        # DIFFERENT compute ids through one Cores (the reference's
+        # kernelWithId concurrency contract, Worker.cs:291-316) otherwise
+        # interleave read-modify-write on the worker's buffer/coverage
+        # dicts.  The bench starts after acquisition so one id's measured
+        # time never includes waiting on another id's phase.
+        with w.lock:
+            self._run_worker_locked(
+                w, kernel_names, params, compute_id, offset, size,
+                local_range, global_range, pipeline, blobs, pipeline_type,
+                value_args, write_all_owner,
+            )
+
+    def _run_worker_locked(
+        self,
+        w: Worker,
+        kernel_names: Sequence[str],
+        params: Sequence[ClArray],
+        compute_id: int,
+        offset: int,
+        size: int,
+        local_range: int,
+        global_range: int,
+        pipeline: bool,
+        blobs: int,
+        pipeline_type: int,
+        value_args,
+        write_all_owner: dict[int, int],
+    ) -> None:
         w.start_bench(compute_id)
         single = self.num_devices == 1
         try:
@@ -601,10 +631,16 @@ class Cores:
         for i in sorted(latest.values()):
             w, p, offset, size, write_all = pending[i]
             epw = p.flags.elements_per_work_item
-            if write_all:
-                handles.append(w.download_async(p, 0, p.size, True))
-            else:
-                handles.append(w.download_async(p, offset * epw, size * epw, False))
+            # under the worker's phase lock: another host thread's lane may
+            # be mid-phase replacing this worker's buffer entries — reading
+            # them unlocked would hand back a pre-kernel buffer
+            with w.lock:
+                if write_all:
+                    handles.append(w.download_async(p, 0, p.size, True))
+                else:
+                    handles.append(
+                        w.download_async(p, offset * epw, size * epw, False)
+                    )
         for h in handles:
             Worker.finish_download(h)
 

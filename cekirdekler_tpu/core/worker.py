@@ -21,6 +21,7 @@ NDRange offsets being launch parameters; ours are runtime scalars too).
 
 from __future__ import annotations
 
+import threading
 import time
 from functools import partial
 from typing import Any, Sequence
@@ -91,6 +92,15 @@ class Worker:
     def __init__(self, device: jax.Device, index: int):
         self.device = device
         self.index = index
+        # serializes whole lane phases when several host threads drive
+        # DIFFERENT compute ids through one Cores concurrently (the
+        # reference's kernelWithId clones kernels per (name, computeId)
+        # for exactly this, Worker.cs:291-316, and wraps worker phases in
+        # lock(workers[i]), Cores.cs:751,779,826).  _buffers/_uploaded are
+        # read-modify-write sequences per array key — unserialized, two
+        # compute ids touching one array lose updates, and fence() would
+        # iterate the dict while another lane inserts.
+        self.lock = threading.RLock()
         # array-object → device buffer (reference: Worker.cs:194)
         self._buffers: dict[int, Any] = {}
         self._buffer_owner: dict[int, ClArray] = {}  # strong refs, like the reference
@@ -373,7 +383,8 @@ class Worker:
         O(1) round trips per chip, not O(buffers).  On tunneled backends
         ``block_until_ready`` can return before remote execution finishes,
         so the host-materialized probe is the reliable fence."""
-        bufs = [b for b in self._buffers.values() if b.size]
+        with self.lock:
+            bufs = [b for b in self._buffers.values() if b.size]
         if not bufs:
             return
         np.asarray(_fence_probe(bufs))

@@ -247,3 +247,29 @@ def test_cores_adaptive_toggle_clears_balancer_state():
         assert not cr.cores.histories and not cr.cores._cont_ranges
     finally:
         cr.dispose()
+
+
+def test_freeze_keeps_history_fresh():
+    # during a freeze the smoothing window must keep receiving measured
+    # shares; otherwise a post-freeze workload shift is steered by stale rows
+    state = BalanceState()
+    hist = BalanceHistory(weighted=True)
+    ranges = [512, 512]
+    for _ in range(6):
+        ranges = load_balance([1.0, 1.0], ranges, 1024, 64, hist, state=state)
+    assert ranges == [512, 512]  # balanced -> frozen
+    assert len(hist.rows) == 6  # window kept filling during the freeze
+
+
+def test_wrap_override_failure_leaves_flags_intact():
+    from cekirdekler_tpu import ClArray
+    from cekirdekler_tpu.arrays.clarray import wrap
+    from cekirdekler_tpu.errors import ComputeValidationError
+
+    b = ClArray(np.zeros(8, np.float64))
+    before = b.flags
+    with pytest.raises(ComputeValidationError):
+        wrap(b, alignment_bytes=48)  # not a power of two
+    with pytest.raises(ComputeValidationError):
+        wrap(b, alignment_bytes=4)  # smaller than float64 itemsize
+    assert b.flags == before
