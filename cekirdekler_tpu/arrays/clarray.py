@@ -86,6 +86,16 @@ class TransferFlags:
         return " ".join(parts)
 
 
+def _check_alignment(flags: "TransferFlags", dtype: np.dtype) -> None:
+    """The one dtype-aware alignment rule, shared by the ClArray ctor,
+    migration, and wrap() override paths."""
+    if flags.alignment_bytes < dtype.itemsize:
+        raise ComputeValidationError(
+            f"alignment_bytes {flags.alignment_bytes} smaller than "
+            f"dtype item size {dtype.itemsize}"
+        )
+
+
 class _ComputeMixin:
     """Shared compute/chaining surface (reference: ICanCompute + ICanBind,
     ClArray.cs:34-76,665-709)."""
@@ -263,11 +273,7 @@ class ClArray(_ComputeMixin):
         self._struct_source: np.ndarray | None = None
 
     def _check_alignment_for(self, dtype: np.dtype) -> None:
-        if self.flags.alignment_bytes < dtype.itemsize:
-            raise ComputeValidationError(
-                f"alignment_bytes {self.flags.alignment_bytes} smaller than "
-                f"dtype item size {dtype.itemsize}"
-            )
+        _check_alignment(self.flags, dtype)
 
     @classmethod
     def wrap_structs(cls, arr: np.ndarray, name: str | None = None,
@@ -496,11 +502,7 @@ def wrap(obj: Any, **flag_overrides) -> ClArray:
             # corrupted flags
             candidate = replace(obj.flags, **flag_overrides)
             candidate.validate()
-            if candidate.alignment_bytes < obj.dtype.itemsize:
-                raise ComputeValidationError(
-                    f"alignment_bytes {candidate.alignment_bytes} smaller "
-                    f"than dtype item size {obj.dtype.itemsize}"
-                )
+            _check_alignment(candidate, obj.dtype)
             obj.flags = candidate
         return obj
     if isinstance(obj, FastArr):

@@ -111,6 +111,14 @@ class Cores:
         # lane blocks on the event before its compute phase, so triggering
         # starts all lanes simultaneously
         self.dispatch_gate = None
+        # lane tracing (observability for the multi-chip dispatch proof):
+        # when on, each plain-path lane records (worker index, dispatch-done
+        # timestamp, join-done timestamp) — dispatch-done is when the async
+        # XLA launch returned to the host, join-done is when the lane's
+        # readbacks materialized.  All lanes dispatching before the first
+        # join completes is the "N chips in flight concurrently" evidence.
+        self.trace_lanes = False
+        self.lane_trace: dict[int, list[tuple[int, float, float]]] = {}
 
     @property
     def adaptive_load_balancer(self) -> bool:
@@ -270,6 +278,11 @@ class Cores:
             if p.flags.write_all and active
         }
 
+        if self.trace_lanes:
+            # the trace describes ONE call: stale entries from earlier calls
+            # would mix into the first-join comparison and leak memory
+            with self._lock:
+                self.lane_trace.pop(compute_id, None)
         futures = []
         for i, w in enumerate(self.workers):
             if ranges[i] <= 0:
@@ -397,6 +410,7 @@ class Cores:
                     offset, size, local_range, global_range, local_range,
                     repeats=self.repeat_count, sync_kernel=self.repeat_sync_kernel,
                 )
+            t_dispatched = time.perf_counter() if self.trace_lanes else 0.0
             # D2H
             handles = []
             for idx, p in enumerate(params):
@@ -426,6 +440,11 @@ class Cores:
                     )
             for h in handles:
                 Worker.finish_download(h)
+            if self.trace_lanes:
+                with self._lock:
+                    self.lane_trace.setdefault(compute_id, []).append(
+                        (w.index, t_dispatched, time.perf_counter())
+                    )
         finally:
             w.end_bench(compute_id)
 

@@ -113,6 +113,14 @@ class KernelProgram:
     def kernel_names(self) -> list[str]:
         return list(self._c_kernels.keys()) + list(self._py_kernels.keys())
 
+    @property
+    def compiled_count(self) -> int:
+        """Number of distinct jitted launch geometries in the cache — the
+        binary-ladder promise is that this stays O(log(range/step)) no
+        matter how many distinct splits the balancer produces."""
+        with self._lock:
+            return len(self._cache)
+
     def __contains__(self, name: str) -> bool:
         return name in self._c_kernels or name in self._py_kernels
 

@@ -210,3 +210,18 @@ def test_nbody_device_ranking_runs():
     devs = _cpus().subset(2)
     ranked = devs.with_highest_nbody_performance(n=128, iters=1)
     assert len(ranked) == 2
+
+
+def test_compute_path_proof_invariants():
+    """VERDICT r3 #1: the flagship compute() multi-chip scaling proxy —
+    compile-count invariance, full dispatch concurrency, work-equal
+    convergence, single-chip-exact assembly."""
+    from cekirdekler_tpu.benchrig import compute_path_proof
+
+    p = compute_path_proof(ndev=8, iters=24)
+    assert p["ok"] is True
+    assert p["compile_count_invariant"] is True
+    assert p["all_lanes_in_flight_together"] is True
+    assert p["image_exact_vs_single_chip"] is True
+    assert p["work_imbalance_final"] < 1.1 < p["work_imbalance_first"]
+    assert p["convergence_iters"] is not None
