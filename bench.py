@@ -343,6 +343,16 @@ def main() -> None:
     # Balancer on the 8-device rig with skewed per-range load (r2 #4).
     rig = balancer_rig_section()
 
+    # Lowering faceoff (r3 #3): XLA vs Pallas lowering of the SAME kernel-
+    # language programs at device throughput — dependent-chain timing, one
+    # host sync, RTT subtracted (robust to transport caching/elision and
+    # RTT drift).  Covers the widened Pallas subset: elementwise+divergent
+    # loop (mandelbrot), lane-uniform gather loop (n-body -> SMEM operand),
+    # static shifted windows (wave stencil -> halo blocks).
+    from cekirdekler_tpu.workloads import lowering_faceoff
+
+    faceoff = section("lowering_faceoff", lambda: lowering_faceoff())
+
     result = {
         "metric": "mandelbrot_throughput",
         "value": round(full.mpixels_per_sec, 3),
@@ -372,6 +382,7 @@ def main() -> None:
         "hbm_measurement_suspect": bool(hbm_util > 1.0),
         "convergence_iters_1chip_note": "vacuous on 1 chip; see balancer_rig",
         "balancer_rig": rig,
+        "lowering_faceoff": faceoff,
         "errors": errors,
         "note": (
             "vs_tuned_loop ~1.0 = no framework overhead over a hand-written "

@@ -34,6 +34,8 @@ __all__ = [
     "run_nbody",
     "run_stream",
     "convergence_iterations",
+    "WAVE_SRC",
+    "lowering_faceoff",
 ]
 
 
@@ -576,3 +578,206 @@ def convergence_iterations(
     (BASELINE.md: 'iterations until max share delta < step')."""
     res = run_mandelbrot(devices, width=width, height=height, max_iter=max_iter, iters=16, warmup=0)
     return res.convergence_iters
+
+
+# ---------------------------------------------------------------------------
+# lowering faceoff: the two kernel-language lowerings compared at device
+# throughput, tunnel-robustly
+# ---------------------------------------------------------------------------
+
+# 8-tap wave-equation stencil (reference: Kamera.cs waveEquation shape,
+# Kamera.cs:233-268) — static shifts crossing rows and lanes; exercises
+# the Pallas halo-block path.
+WAVE_SRC = """
+__kernel void wave(__global float* p, __global float* pold, __global float* pnew) {
+    int i = get_global_id(0);
+    float lap = p[i-1] + p[i+1] + p[i-128] + p[i+128] + p[i-129] + p[i+129]
+              + p[i-127] + p[i+127] - 8.0f*p[i];
+    pnew[i] = 2.0f*p[i] - pold[i] + 0.2f*lap;
+}
+"""
+
+
+def lowering_faceoff(
+    nbody_n: int = 8192,
+    wave_n: int = 1 << 24,
+    mandel_wh: int = 2048,
+    reps: int = 16,
+    wave_reps: int = 192,
+    nbody_reps: int = 64,
+) -> dict:
+    """Device-throughput comparison of the XLA and Pallas lowerings on the
+    three subset shapes: mandelbrot (elementwise + divergent loop), n-body
+    (lane-uniform gather loop -> SMEM operand), wave stencil (static
+    shifts -> halo blocks).
+
+    Tunnel-robust methodology: each measurement runs ``reps`` DEPENDENT
+    steps INSIDE one jitted ``lax.fori_loop`` (each step's output feeds
+    the next step's input, so steps cannot be elided, and the per-launch
+    dispatch floor — several ms over a tunneled backend — is paid once,
+    not per step) with exactly ONE host materialization at the end; the
+    measured tunnel RTT is subtracted once.  This reports DEVICE
+    throughput of the lowering itself — the compute()-harness benches
+    (run_mandelbrot / run_nbody) include scheduler + transfer + sync costs
+    on top and answer a different question.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from .kernel import codegen, lang
+    from .kernel.pallas_backend import build_kernel_fn_pallas
+
+    t = jnp.zeros(8, jnp.float32)
+    np.asarray(t)
+    rtt = min(
+        (lambda t0: (np.asarray(t + 1.0), time.perf_counter() - t0)[1])(
+            time.perf_counter()
+        )
+        for _ in range(5)
+    )
+
+    def chain(fn, arrs, make_vals, rotate, touch, nreps):
+        """Best-of-3 seconds per step: nreps dependent steps in ONE jitted
+        fori_loop, one host sync, RTT subtracted (clamped at 5% of wall:
+        an RTT sample larger than the run must not produce negative or
+        near-zero times).  Only valid when each step READS the previous
+        step's output — a write-only chain would be dead-code-eliminated
+        down to its last step.  The best-of-3 samples are themselves
+        chained (each run's outputs are the next run's inputs) so no two
+        samples are identical executions either — a replayed/elided
+        sample would otherwise win the min()."""
+
+        @jax.jit
+        def run(arrs):
+            def step(j, cur):
+                out = fn(0, cur, make_vals(j))
+                return rotate(cur, out)
+
+            return lax.fori_loop(0, nreps, step, tuple(arrs))
+
+        cur = run(tuple(arrs))
+        np.asarray(touch(cur)[:8])
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            cur = run(tuple(cur))
+            np.asarray(touch(cur)[:8])
+            wall = time.perf_counter() - t0
+            best = min(best, max(wall - rtt, wall * 0.05) / nreps)
+        return best
+
+    def faceoff(kdef, arrs, make_vals, rotate, touch, nreps):
+        n = arrs[0].shape[0]
+        xla_fn, _ = codegen.build_kernel_fn(kdef, n, 256, n)
+        # force=True: measure the Pallas path even where the routing
+        # policy (informed by THIS bench) prefers XLA — the faceoff is
+        # the evidence the policy rests on
+        pl_fn, _ = build_kernel_fn_pallas(kdef, n, 256, n, force=True)
+        dt_x = chain(xla_fn, arrs, make_vals, rotate, touch, nreps)
+        dt_p = chain(pl_fn, arrs, make_vals, rotate, touch, nreps)
+        v0 = make_vals(0)
+        ox = jax.jit(xla_fn)(0, tuple(arrs), v0)
+        op = jax.jit(pl_fn)(0, tuple(arrs), v0)
+        match = all(
+            np.allclose(np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-4)
+            for a, b in zip(ox, op)
+        )
+        return dt_x, dt_p, match
+
+    rng = np.random.default_rng(42)
+    out: dict = {"rtt_ms": round(rtt * 1e3, 1), "reps": reps,
+                 "wave_reps": wave_reps, "nbody_reps": nbody_reps}
+
+    # mandelbrot writes a fresh image each launch (out is write-only, so a
+    # dependent in-jit chain is impossible — it would dead-code-eliminate);
+    # instead: reps separate launches with DISTINCT x0 args (distinct args
+    # defeat transport-level caching), floor paid per launch.  The Pallas
+    # time is 3-4x the dispatch floor, so the ratio is mildly compressed
+    # toward 1 — reported as-is.
+    kdef = {k.name: k for k in lang.parse_kernels(MANDELBROT_SRC)}["mandelbrot"]
+    N = mandel_wh * mandel_wh
+    marrs = (jnp.zeros(N, jnp.float32),)
+
+    def mandel_time(fn):
+        f = jax.jit(fn)
+        mk = lambda j: (
+            np.float32(-2.0 - 1e-4 * j), np.float32(-1.25),
+            np.float32(2.5 / mandel_wh), np.float32(2.5 / mandel_wh),
+            np.int32(mandel_wh), np.int32(256),
+        )
+        o = f(0, marrs, mk(999))
+        np.asarray(o[0][:8])
+        best = float("inf")
+        # x0 values are distinct across ALL launches of ALL best-of
+        # samples (j counts globally) — a transport replaying any earlier
+        # identical execution would need a matching x0, and there is none
+        j = 0
+        for _ in range(3):
+            t0 = time.perf_counter()
+            o = None
+            for _ in range(reps):
+                o = f(0, marrs, mk(j))
+                j += 1
+            np.asarray(o[0][:8])
+            wall = time.perf_counter() - t0
+            best = min(best, max(wall - rtt, wall * 0.05) / reps)
+        return best
+
+    xla_fn, _ = codegen.build_kernel_fn(kdef, N, 256, N)
+    pl_fn, _ = build_kernel_fn_pallas(kdef, N, 256, N)
+    dt_x, dt_p = mandel_time(xla_fn), mandel_time(pl_fn)
+    out["mandelbrot"] = {
+        "xla_mpix_s": round(N / dt_x / 1e6, 1),
+        "pallas_mpix_s": round(N / dt_p / 1e6, 1),
+        "speedup": round(dt_x / dt_p, 2),
+    }
+
+    # n-body: leapfrog chain — positions drift by the updated velocities
+    # between steps (the kernel itself updates velocities only, matching
+    # the reference; a static-positions chain would let XLA hoist the
+    # loop-invariant O(n^2) accel pass out of the rep loop)
+    kdef = {k.name: k for k in lang.parse_kernels(NBODY_SRC)}["nBody"]
+    narrs = tuple(
+        jnp.asarray(rng.standard_normal(nbody_n).astype(np.float32))
+        for _ in range(6)
+    )
+    nvals = (np.int32(nbody_n), np.float32(1e-4))
+    dt_x, dt_p, match = faceoff(
+        kdef, narrs, lambda j: nvals,
+        rotate=lambda cur, o: (
+            cur[0] + o[3] * 1e-4, cur[1] + o[4] * 1e-4, cur[2] + o[5] * 1e-4,
+            o[3], o[4], o[5],
+        ),
+        touch=lambda o: o[3],
+        nreps=nbody_reps,
+    )
+    gp = nbody_n * nbody_n / 1e9
+    out["nbody"] = {
+        "xla_gpairs_s": round(gp / dt_x, 3),
+        "pallas_gpairs_s": round(gp / dt_p, 3),
+        "speedup": round(dt_x / dt_p, 2),
+        "match": match,
+    }
+
+    # wave: leapfrog chain (pnew -> p -> pold)
+    kdef = {k.name: k for k in lang.parse_kernels(WAVE_SRC)}["wave"]
+    warrs = tuple(
+        jnp.asarray((rng.standard_normal(wave_n) * 0.5).astype(np.float32))
+        for _ in range(3)
+    )
+    dt_x, dt_p, match = faceoff(
+        kdef, warrs, lambda j: (),
+        rotate=lambda cur, o: (o[2], cur[0], cur[1]),
+        touch=lambda o: o[2],
+        nreps=wave_reps,
+    )
+    out["wave_stencil"] = {
+        "xla_ms": round(dt_x * 1e3, 3),
+        "pallas_ms": round(dt_p * 1e3, 3),
+        "xla_gelem_s": round(wave_n / dt_x / 1e9, 2),
+        "pallas_gelem_s": round(wave_n / dt_p / 1e9, 2),
+        "speedup": round(dt_x / dt_p, 2),
+        "match": match,
+    }
+    return out

@@ -1,12 +1,12 @@
 """Differential fuzzing of the two kernel lowerings.
 
-Random elementwise kernels (arithmetic, builtins with safe domains,
-branches, bounded loops with accumulators) are compiled through BOTH the
-vectorized XLA lowering (kernel/codegen.py) and the Pallas tile lowering
-(kernel/pallas_backend.py, interpret mode) and must agree on random
-inputs — any divergence is a compiler bug in one of them.  The generator
-stays inside the Pallas elementwise subset so every case exercises both
-backends.
+Random kernels (arithmetic, builtins with safe domains, branches, bounded
+loops with accumulators, statically-shifted window loads, lane-uniform
+gather loops) are compiled through BOTH the vectorized XLA lowering
+(kernel/codegen.py) and the Pallas tile lowering (kernel/pallas_backend.py,
+interpret mode) and must agree on random inputs — any divergence is a
+compiler bug in one of them.  The generator stays inside the (round-4
+widened) Pallas subset so every case exercises both backends.
 """
 
 import numpy as np
@@ -64,6 +64,23 @@ def _gen_kernel(seed: int) -> str:
     body = ["int i = get_global_id(0);",
             "float x = a[i];", "float y = b[i];"]
     vars_ = ["x", "y"]
+    # statically-shifted window load (halo-block path): row- and/or
+    # lane-crossing shifts, clamped at the buffer edge
+    if rng.integers(0, 2):
+        c = int(rng.choice([-257, -129, -128, -3, -1, 1, 2, 127, 128, 200]))
+        body.append(f"float ws = b[i + ({c})] * 0.5f;")
+        vars_.append("ws")
+    # lane-uniform gather loop (SMEM operand path): streams `a` at a
+    # uniform index, the n-body inner-loop shape
+    if rng.integers(0, 2):
+        k = int(rng.integers(3, 9))
+        d = int(rng.integers(0, 4))
+        body.append("float us = 0.0f;")
+        body.append(
+            f"for (int uj = 0; uj < {k}; uj++) "
+            f"{{ us = us + a[uj + {d}] * 0.0625f; }}"
+        )
+        vars_.append("us")
     # a few straight-line statements
     for v in ("t0", "t1"):
         body.append(f"float {v} = {_gen_expr(rng, 3, vars_)};")
@@ -108,7 +125,8 @@ def test_lowerings_agree(seed):
     kdef = lang.parse_kernels(src)[0]
     xla_fn, _ = codegen.build_kernel_fn(kdef, N, 64, N)
     try:
-        pl_fn, _ = build_kernel_fn_pallas(kdef, N, 64, N, interpret=True)
+        pl_fn, _ = build_kernel_fn_pallas(kdef, N, 64, N, interpret=True,
+                                         force=True)
     except PallasUnsupported:
         pytest.fail(f"generator left the elementwise subset:\n{src}")
     rng = np.random.default_rng(1000 + seed)

@@ -4,9 +4,14 @@ tests/kernel_oracle.py executes kernels one work item at a time with real
 Python control flow — the language's semantic definition.  The compiled
 vectorized lowering must match it on: gather loops (uniform AND per-lane
 indices), private arrays, divergent branches with early returns, shifted
-windows, and integer arithmetic with C division semantics.  These cover
-exactly the features the elementwise Pallas subset excludes, closing the
-oracle gap left by tests/test_lowering_fuzz.py.
+windows, and integer arithmetic with C division semantics.
+
+Every case is ALSO pushed through the Pallas tile lowering
+(kernel/pallas_backend.py, interpret mode) whenever the kernel is inside
+its subset — since the round-4 widening that includes shifted windows and
+lane-uniform gathers, so most of these now fuzz three implementations
+against each other (oracle / XLA / Pallas); per-lane gathers and private
+arrays still fall back and are only two-way.
 """
 
 import numpy as np
@@ -21,6 +26,11 @@ N = 128
 
 
 def _run_both(src: str, arrays: dict, values: dict, atol=1e-4):
+    from cekirdekler_tpu.kernel.pallas_backend import (
+        PallasUnsupported,
+        build_kernel_fn_pallas,
+    )
+
     kdef = lang.parse_kernels(src)[0]
     order = [p.name for p in kdef.params if p.is_pointer]
     vals = tuple(values[p.name] for p in kdef.params if not p.is_pointer)
@@ -36,6 +46,18 @@ def _run_both(src: str, arrays: dict, values: dict, atol=1e-4):
         np.testing.assert_allclose(
             out_c[n], oracle_arrays[n], rtol=1e-4, atol=atol,
             err_msg=f"compiled vs oracle divergence in array {n!r}:\n{src}",
+        )
+
+    # three-way: the Pallas tile lowering, when the kernel is in-subset
+    try:
+        pl_fn, _ = build_kernel_fn_pallas(kdef, N, 64, N, interpret=True,
+                                         force=True)
+    except PallasUnsupported:
+        return
+    for n, a in zip(order, pl_fn(0, jarrs, vals)):
+        np.testing.assert_allclose(
+            np.asarray(a), oracle_arrays[n], rtol=1e-4, atol=atol,
+            err_msg=f"pallas vs oracle divergence in array {n!r}:\n{src}",
         )
 
 

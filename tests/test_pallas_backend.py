@@ -64,6 +64,33 @@ __kernel void shift(__global float* x, __global float* o) {
 }
 """
 
+STENCIL = """
+__kernel void wave(__global float* p, __global float* pold, __global float* pnew) {
+    int i = get_global_id(0);
+    float lap = p[i-1] + p[i+1] + p[i-128] + p[i+128] + p[i-129] + p[i+129]
+              + p[i-127] + p[i+127] - 8.0f*p[i];
+    pnew[i] = 2.0f*p[i] - pold[i] + 0.2f*lap;
+}
+"""
+
+UNIFORM_LOOP = """
+__kernel void dotrow(__global float* w, __global float* x, __global float* o, int m) {
+    int i = get_global_id(0);
+    float acc = 0.0f;
+    for (int j = 0; j < m; j++) {
+        acc = acc + w[j] * x[i];
+    }
+    o[i] = acc + w[0];
+}
+"""
+
+STORE_SHIFT_MIX = """
+__kernel void m(__global float* a) {
+    int i = get_global_id(0);
+    a[i] = a[i + 1] * 2.0f;
+}
+"""
+
 
 def _kdef(src: str) -> lang.KernelDef:
     return lang.parse_kernels(src)[0]
@@ -78,7 +105,8 @@ def _both(src: str, arrays, values=(), chunk=None, offset=0, global_size=None):
     chunk = chunk or arrays[0].shape[0]
     gs = global_size or chunk
     xla_fn, _ = codegen.build_kernel_fn(kdef, chunk, 64, gs)
-    pl_fn, _ = build_kernel_fn_pallas(kdef, chunk, 64, gs, interpret=True)
+    pl_fn, _ = build_kernel_fn_pallas(kdef, chunk, 64, gs, interpret=True,
+                                     force=True)
     jarr = tuple(jnp.asarray(a) for a in arrays)
     out_x = xla_fn(offset, jarr, values)
     out_p = pl_fn(offset, jarr, values)
@@ -130,10 +158,94 @@ def test_offset_window_into_larger_buffer():
     np.testing.assert_allclose(got[off:off + chunk], 2.0 * x[off:off + chunk])
 
 
-@pytest.mark.parametrize("src,name", [(GATHER, "gather"), (SHIFTED, "shift")])
-def test_non_elementwise_rejected(src, name):
+def test_per_lane_gather_rejected():
     with pytest.raises(PallasUnsupported):
-        build_kernel_fn_pallas(_kdef(src), 256, 64, 256, interpret=True)
+        build_kernel_fn_pallas(_kdef(GATHER), 256, 64, 256, interpret=True)
+
+
+def test_store_plus_shift_read_rejected():
+    """A store into an array that is also shift-read would see stale
+    neighbor tiles; must fall back to the XLA lowering."""
+    with pytest.raises(PallasUnsupported):
+        build_kernel_fn_pallas(_kdef(STORE_SHIFT_MIX), 256, 64, 256, interpret=True)
+
+
+def test_shifted_window_matches_xla():
+    """a[i+1] now lowers to a halo block + lane roll (widened subset)."""
+    n = 1024
+    x = np.arange(n, dtype=np.float32)
+    o = np.zeros(n, np.float32)
+    out_x, out_p = _both(SHIFTED, (x, o))
+    np.testing.assert_array_equal(np.asarray(out_x[1]), np.asarray(out_p[1]))
+    got = np.asarray(out_p[1])
+    # edge clamp: last element reads x[n-1] (nearest valid), same as the
+    # XLA padded-view semantics
+    assert got[-1] == x[-1]
+    np.testing.assert_array_equal(got[:-1], x[1:])
+
+
+def test_stencil_multi_tap_matches_xla_across_offsets():
+    """8-tap wave stencil: row- and lane-crossing shifts, offset launches
+    into a larger buffer, edge-clamp agreement at both ends."""
+    n, chunk = 2048, 512
+    rng = np.random.default_rng(11)
+    arrays = tuple(rng.standard_normal(n).astype(np.float32) for _ in range(3))
+    for off in (0, 512, n - chunk):
+        out_x, out_p = _both(STENCIL, arrays, chunk=chunk, offset=off,
+                             global_size=n)
+        np.testing.assert_allclose(
+            np.asarray(out_x[2]), np.asarray(out_p[2]), rtol=1e-5, atol=1e-5)
+
+
+def test_uniform_gather_loop_matches_xla():
+    """The n-body shape: a lane-uniform loop index streaming a second
+    buffer (SMEM operand) plus a constant-index broadcast w[0]."""
+    n = 512
+    rng = np.random.default_rng(13)
+    w = rng.standard_normal(n).astype(np.float32)
+    x = rng.standard_normal(n).astype(np.float32)
+    o = np.zeros(n, np.float32)
+    out_x, out_p = _both(UNIFORM_LOOP, (w, x, o), values=(17,))
+    np.testing.assert_allclose(
+        np.asarray(out_x[2]), np.asarray(out_p[2]), rtol=1e-5, atol=1e-5)
+
+
+def test_nbody_kernel_matches_xla():
+    """The full NBODY_SRC kernel (uniform x[j]/y[j]/z[j] loads + elementwise
+    velocity updates) through both lowerings."""
+    from cekirdekler_tpu.workloads import NBODY_SRC
+
+    n = 256
+    rng = np.random.default_rng(17)
+    arrays = tuple(rng.standard_normal(n).astype(np.float32) for _ in range(6))
+    kdef = {k.name: k for k in lang.parse_kernels(NBODY_SRC)}["nBody"]
+    import jax.numpy as jnp
+
+    xla_fn, _ = codegen.build_kernel_fn(kdef, n, 64, n)
+    pl_fn, _ = build_kernel_fn_pallas(kdef, n, 64, n, interpret=True)
+    jarr = tuple(jnp.asarray(a) for a in arrays)
+    vals = (np.int32(n), np.float32(1e-3))
+    out_x = xla_fn(0, jarr, vals)
+    out_p = pl_fn(0, jarr, vals)
+    for a, b in zip(out_x, out_p):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-5, atol=2e-5)
+
+
+def test_smem_limit_falls_back_inside_fn(monkeypatch):
+    """Uniform-read buffers beyond the SMEM budget delegate to the XLA
+    lowering at trace time — same results, no failure."""
+    from cekirdekler_tpu.kernel import pallas_backend
+
+    monkeypatch.setattr(pallas_backend, "SMEM_UNIFORM_LIMIT", 64)  # bytes
+    n = 512
+    rng = np.random.default_rng(19)
+    w = rng.standard_normal(n).astype(np.float32)
+    x = rng.standard_normal(n).astype(np.float32)
+    o = np.zeros(n, np.float32)
+    out_x, out_p = _both(UNIFORM_LOOP, (w, x, o), values=(9,))
+    np.testing.assert_allclose(
+        np.asarray(out_x[2]), np.asarray(out_p[2]), rtol=1e-5, atol=1e-5)
 
 
 def test_chunk_not_lane_aligned_rejected():
@@ -152,3 +264,49 @@ def test_registry_falls_back_off_tpu():
     assert fn_cpu is not None
     fn_gather, _ = prog.launcher("gather", 256, 64, 256, platform="tpu")
     assert fn_gather is not None  # fell back to the XLA lowering
+
+
+def test_shift_only_routing_veto():
+    """Measured routing policy: shift-only kernels prefer the XLA lowering
+    (faster on HBM-bound single-pass stencils); force=True overrides for
+    direct measurement."""
+    with pytest.raises(PallasUnsupported):
+        build_kernel_fn_pallas(_kdef(STENCIL), 512, 64, 512, interpret=True)
+    fn, _ = build_kernel_fn_pallas(_kdef(STENCIL), 512, 64, 512,
+                                   interpret=True, force=True)
+    assert fn is not None
+
+
+def test_multi_tile_grid_halo_and_smem():
+    """grid > 1 coverage for the widened paths: small block_rows force
+    multiple tiles, so the pl.Element halo index map, the 8-row alignment
+    rounding in _halo_rows, and per-tile SMEM loads all execute — with an
+    offset launch into a larger buffer on top."""
+    import jax.numpy as jnp
+
+    MIXED = """
+    __kernel void mx(__global float* w, __global float* p, __global float* o, int m) {
+        int i = get_global_id(0);
+        float acc = p[i-1] + p[i+1] + p[i-130] + p[i+130];
+        for (int j = 0; j < m; j++) {
+            acc = acc + w[j] * 0.125f;
+        }
+        o[i] = acc;
+    }"""
+    kdef = _kdef(MIXED)
+    n, chunk, off = 16384, 8192, 4096
+    rng = np.random.default_rng(23)
+    arrays = tuple(
+        jnp.asarray(rng.standard_normal(n).astype(np.float32)) for _ in range(3)
+    )
+    vals = (np.int32(11),)
+    xla_fn, _ = codegen.build_kernel_fn(kdef, chunk, 64, n)
+    # block_rows=16 -> rows=16, grid=4 (multi-tile); halo h rounds to 4
+    pl_fn, _ = build_kernel_fn_pallas(kdef, chunk, 64, n, block_rows=16,
+                                      interpret=True, force=True)
+    for o in (0, off, n - chunk):
+        got_x = xla_fn(o, arrays, vals)
+        got_p = pl_fn(o, arrays, vals)
+        np.testing.assert_allclose(
+            np.asarray(got_x[2]), np.asarray(got_p[2]), rtol=1e-5, atol=1e-5,
+            err_msg=f"grid>1 divergence at offset {o}")
