@@ -194,9 +194,10 @@ class Transformer:
             # and the sequence/tensor-parallel forms are ring/ulysses.
             from ..ops.flash_attention import auto_block, flash_attention
 
-            blk = auto_block(q.shape[1])
-            if blk is not None:  # degenerate tiling → dense is faster
-                return flash_attention(q, k, v, True, blk, blk)
+            bq = auto_block(q.shape[1], 256)
+            bk = auto_block(q.shape[1], 512)
+            if bq is not None:  # degenerate tiling → dense is faster
+                return flash_attention(q, k, v, True, bq, bk)
         return attention_reference(q, k, v, causal=True)
 
     def _block(self, params: dict, x, mesh: Mesh | None):

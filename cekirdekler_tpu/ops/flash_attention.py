@@ -13,9 +13,12 @@ Causal masking skips fully-masked K blocks entirely (the loop bound per Q
 block is derived from its last query position), halving causal work.
 
 Gradients: ``flash_attention`` carries a ``jax.custom_vjp`` whose backward
-recomputes scores with standard XLA ops (the flash-attention trade: spend
-FLOPs to avoid storing the [T, T] probability matrix; here the recompute is
-left to XLA fusion rather than a handwritten backward kernel).
+is ALSO tiled Pallas (FlashAttention-2 structure): the forward saves the
+per-row logsumexp, the backward recomputes each score block from it (the
+flash trade — FLOPs for memory) and runs two kernels, one accumulating dq
+across k blocks and one accumulating dk/dv across q blocks, so training
+memory stays O(T) + O(block²) — the full [T, T] probability matrix is
+never materialized in either direction.
 
 Mosaic constraints mirror ops/mandelbrot.py: no ±inf mask arithmetic in the
 carry path (a −1e30 additive mask keeps every exp finite) and accumulators
@@ -37,17 +40,22 @@ __all__ = ["flash_attention", "flash_attention_parts", "auto_block"]
 _NEG = -1e30  # finite "-inf": exp(_NEG - m) == 0 without nan hazards
 
 
-def auto_block(T: int, target: int = 128, floor: int = 8) -> int | None:
+def auto_block(T: int, target: int = 512, floor: int = 8) -> int | None:
     """Largest power-of-two block ≤ ``target`` dividing ``T``, or None when
     only degenerate tiles (< ``floor``) divide it — callers should fall
     back to dense attention then (a (1, D)-tile grid of T² steps is far
-    slower than the dense einsum it replaces)."""
+    slower than the dense einsum it replaces).
+
+    The 512 default target comes from an on-chip block sweep (T=4096,
+    D=64, f32): small 128² blocks leave the MXU ~6% utilized (the
+    per-block softmax VPU work dominates); 256-1024 element blocks are
+    1.5-3x faster, with q=256/k=512 the fwd+bwd sweet spot."""
     blk = math.gcd(T, target)
     return blk if blk >= floor else None
 
 
 def _fa_kernel(*refs, scale, block_q, block_k, n_kb, causal, precision,
-               parts=False):
+               parts=False, with_lse=False):
     """One (bh, q-block, k-block) grid step.
 
     The k dimension is the MINOR grid axis: Pallas runs it sequentially per
@@ -69,6 +77,10 @@ def _fa_kernel(*refs, scale, block_q, block_k, n_kb, causal, precision,
         m_scr, l_scr, acc_scr = refs[8:]
         q_pos0 = q_off_ref[0, 0]
         k_pos0 = k_off_ref[0, 0]
+    elif with_lse:
+        q_ref, k_ref, v_ref, o_ref, lse_ref = refs[:5]
+        m_scr, l_scr, acc_scr = refs[5:]
+        q_pos0 = k_pos0 = 0
     else:
         q_ref, k_ref, v_ref, o_ref = refs[:4]
         m_scr, l_scr, acc_scr = refs[4:]
@@ -133,23 +145,54 @@ def _fa_kernel(*refs, scale, block_q, block_k, n_kb, causal, precision,
             o_ref[0] = (
                 acc_scr[...] / jnp.maximum(l_scr[:, 0], 1e-30)[:, None]
             ).astype(o_ref.dtype)
+            if with_lse:
+                lse = m_scr[:, 0] + jnp.log(jnp.maximum(l_scr[:, 0], 1e-30))
+                lse_ref[0] = jnp.broadcast_to(lse[:, None], lse_ref.shape[1:])
+
+
+def _blocks_for(Tq: int, Tk: int, block_q: int, block_k: int):
+    """Effective (bq, bk): the largest divisors of the sequence lengths
+    not exceeding the requested blocks (gcd) — so default-argument calls
+    degrade gracefully for any T a smaller block would have handled
+    (e.g. T=640 with the 256 default -> 128), and only truly degenerate
+    lengths raise."""
+    bq = math.gcd(Tq, block_q)
+    bk = math.gcd(Tk, block_k)
+    if bq < 8 or bk < 8:
+        raise ValueError(
+            f"sequence lengths (Tq={Tq}, Tk={Tk}) admit only degenerate "
+            f"tiles for requested blocks ({block_q}, {block_k}); use "
+            f"auto_block() or pad the sequence"
+        )
+    return bq, bk
+
+
+def _vma_sds(*operands):
+    """ShapeDtypeStruct factory carrying the union of the operands'
+    varying-axes sets — under shard_map every pallas_call output must
+    declare how it varies over mesh axes (a replicated q attending
+    sharded k/v still produces per-shard-varying output)."""
+    try:
+        vma = frozenset().union(*(jax.typeof(o).vma for o in operands))
+        return functools.partial(jax.ShapeDtypeStruct, vma=vma)
+    except (TypeError, AttributeError):
+        return jax.ShapeDtypeStruct
 
 
 @functools.partial(
     jax.jit,
-    static_argnames=("causal", "block_q", "block_k", "interpret", "precision"),
+    static_argnames=("causal", "block_q", "block_k", "interpret", "precision",
+                     "with_lse"),
 )
-def _flash_forward(q, k, v, causal, block_q, block_k, interpret, precision):
+def _flash_forward(q, k, v, causal, block_q, block_k, interpret, precision,
+                   with_lse=False):
+    """Forward pass; ``with_lse=True`` also emits the per-row logsumexp
+    (m + log l) in lane-broadcast layout [B*H, Tq, 128] — the residual
+    the tiled backward reconstructs probabilities from."""
     B, Tq, H, D = q.shape
     Tk = k.shape[1]
     scale = 1.0 / math.sqrt(D)
-    bq = min(block_q, Tq)
-    bk = min(block_k, Tk)
-    if Tq % bq or Tk % bk:
-        raise ValueError(
-            f"sequence lengths (Tq={Tq}, Tk={Tk}) must be multiples of the "
-            f"blocks (bq={bq}, bk={bk})"
-        )
+    bq, bk = _blocks_for(Tq, Tk, block_q, block_k)
     if causal and Tq != Tk:
         raise ValueError("causal flash attention requires Tq == Tk")
     # [B, T, H, D] -> [B*H, T, D]: one grid row per (batch, head)
@@ -159,21 +202,18 @@ def _flash_forward(q, k, v, causal, block_q, block_k, interpret, precision):
     n_kb = Tk // bk
     kernel = functools.partial(
         _fa_kernel, scale=scale, block_q=bq, block_k=bk, n_kb=n_kb,
-        causal=causal, precision=precision,
+        causal=causal, precision=precision, with_lse=with_lse,
     )
     from jax.experimental.pallas import tpu as pltpu
 
-    # under shard_map the output must declare how it varies over mesh axes
-    # (vma): the union of ALL operands' — a replicated q attending sharded
-    # k/v still produces per-shard-varying output
-    try:
-        vma = frozenset(
-            jax.typeof(q3).vma | jax.typeof(k3).vma | jax.typeof(v3).vma
-        )
-        out_shape = jax.ShapeDtypeStruct((B * H, Tq, D), q.dtype, vma=vma)
-    except (TypeError, AttributeError):
-        out_shape = jax.ShapeDtypeStruct((B * H, Tq, D), q.dtype)
-    out = pl.pallas_call(
+    sds = _vma_sds(q3, k3, v3)
+    out_specs = pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0))
+    out_shape = sds((B * H, Tq, D), q.dtype)
+    if with_lse:
+        out_specs = [out_specs,
+                     pl.BlockSpec((1, bq, 128), lambda b, i, j: (b, i, 0))]
+        out_shape = [out_shape, sds((B * H, Tq, 128), jnp.float32)]
+    res = pl.pallas_call(
         kernel,
         grid=(B * H, Tq // bq, n_kb),
         in_specs=[
@@ -181,7 +221,7 @@ def _flash_forward(q, k, v, causal, block_q, block_k, interpret, precision):
             pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+        out_specs=out_specs,
         out_shape=out_shape,
         scratch_shapes=[
             pltpu.VMEM((bq, 128), jnp.float32),  # running max (col 0)
@@ -190,7 +230,13 @@ def _flash_forward(q, k, v, causal, block_q, block_k, interpret, precision):
         ],
         interpret=interpret,
     )(q3, k3, v3)
-    return out.reshape(B, H, Tq, D).transpose(0, 2, 1, 3)
+    if with_lse:
+        out, lse = res
+        return (
+            out.reshape(B, H, Tq, D).transpose(0, 2, 1, 3),
+            lse,  # [B*H, Tq, 128] lane-broadcast, fed to the backward as-is
+        )
+    return res.reshape(B, H, Tq, D).transpose(0, 2, 1, 3)
 
 
 @functools.partial(
@@ -271,6 +317,180 @@ def flash_attention_parts(
     return acc, m, l
 
 
+def _fa_bwd_dq_kernel(*refs, scale, block_q, block_k, n_kb, causal, precision):
+    """Backward dq: grid (bh, q-block, k-block minor).  Recomputes each
+    score block from q/k and the saved logsumexp, accumulates
+    dq += ds · K in VMEM scratch across the k steps."""
+    q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref, dq_ref = refs[:7]
+    (dq_scr,) = refs[7:]
+    qi = pl.program_id(1)
+    kj = pl.program_id(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros_like(dq_scr)
+
+    live = (kj * block_k <= qi * block_q + block_q - 1) if causal else True
+
+    @pl.when(live)
+    def _step():
+        q = q_ref[0].astype(jnp.float32) * scale       # (bq, D)
+        kb = k_ref[0].astype(jnp.float32)              # (bk, D)
+        vb = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)             # (bq, D)
+        lse = lse_ref[0][:, 0]                         # (bq,)
+        dlt = dlt_ref[0][:, 0]                         # (bq,)
+        s = jax.lax.dot_general(
+            q, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=precision,
+        )
+        if causal:
+            q_pos = qi * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = kj * block_k + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(k_pos <= q_pos, s, _NEG)
+        p = jnp.exp(s - lse[:, None])                  # (bq, bk)
+        dp = jax.lax.dot_general(
+            do, vb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=precision,
+        )
+        ds = p * (dp - dlt[:, None])
+        dq_scr[...] = dq_scr[...] + jax.lax.dot_general(
+            ds, kb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=precision,
+        )
+
+    @pl.when(kj == n_kb - 1)
+    def _finish():
+        dq_ref[0] = (dq_scr[...] * scale).astype(dq_ref.dtype)
+
+
+def _fa_bwd_dkv_kernel(*refs, scale, block_q, block_k, n_qb, causal,
+                       precision):
+    """Backward dk/dv: grid (bh, k-block, q-block minor).  Accumulates
+    dv += pᵀ · dO and dk += dsᵀ · q in VMEM scratch across the q steps."""
+    q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref, dk_ref, dv_ref = refs[:8]
+    dk_scr, dv_scr = refs[8:]
+    kj = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    live = (kj * block_k <= qi * block_q + block_q - 1) if causal else True
+
+    @pl.when(live)
+    def _step():
+        q = q_ref[0].astype(jnp.float32) * scale
+        kb = k_ref[0].astype(jnp.float32)
+        vb = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0][:, 0]
+        dlt = dlt_ref[0][:, 0]
+        s = jax.lax.dot_general(
+            q, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=precision,
+        )
+        if causal:
+            q_pos = qi * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = kj * block_k + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(k_pos <= q_pos, s, _NEG)
+        p = jnp.exp(s - lse[:, None])                  # (bq, bk)
+        dv_scr[...] = dv_scr[...] + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),           # pᵀ · do -> (bk, D)
+            preferred_element_type=jnp.float32, precision=precision,
+        )
+        dp = jax.lax.dot_general(
+            do, vb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=precision,
+        )
+        ds = p * (dp - dlt[:, None])
+        dk_scr[...] = dk_scr[...] + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),           # dsᵀ · q -> (bk, D)
+            preferred_element_type=jnp.float32, precision=precision,
+        )
+
+    @pl.when(qi == n_qb - 1)
+    def _finish():
+        dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)   # q pre-scaled
+        dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("causal", "block_q", "block_k", "interpret", "precision"),
+)
+def _flash_backward(q, k, v, out, lse3, do, causal, block_q, block_k,
+                    interpret, precision):
+    """Tiled flash backward: dq in one pallas_call (k minor), dk/dv in a
+    second (q minor).  ``lse3`` arrives lane-broadcast [B*H, Tq, 128]
+    straight from the forward residual (no slice/re-broadcast round
+    trip); delta = rowsum(dO ∘ O) is a cheap XLA reduction."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, Tq, H, D = q.shape
+    Tk = k.shape[1]
+    bq, bk = _blocks_for(Tq, Tk, block_q, block_k)
+    scale = 1.0 / math.sqrt(D)
+    q3 = q.transpose(0, 2, 1, 3).reshape(B * H, Tq, D)
+    k3 = k.transpose(0, 2, 1, 3).reshape(B * H, Tk, D)
+    v3 = v.transpose(0, 2, 1, 3).reshape(B * H, Tk, D)
+    do3 = do.transpose(0, 2, 1, 3).reshape(B * H, Tq, D)
+    # delta_i = sum_d dO_id * O_id, broadcast to the (.., 128) lane layout
+    delta = jnp.einsum(
+        "bqhd,bqhd->bhq", do.astype(jnp.float32), out.astype(jnp.float32)
+    ).reshape(B * H, Tq)
+    dlt3 = jnp.broadcast_to(delta[..., None], (B * H, Tq, 128))
+    sds = _vma_sds(q3, k3, v3, do3)
+    n_qb, n_kb = Tq // bq, Tk // bk
+    tile_q = pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0))
+    tile_ml = pl.BlockSpec((1, bq, 128), lambda b, i, j: (b, i, 0))
+    tile_k_minor = pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0))
+    dq = pl.pallas_call(
+        functools.partial(
+            _fa_bwd_dq_kernel, scale=scale, block_q=bq, block_k=bk,
+            n_kb=n_kb, causal=causal, precision=precision,
+        ),
+        grid=(B * H, n_qb, n_kb),
+        in_specs=[tile_q, tile_k_minor, tile_k_minor, tile_q, tile_ml,
+                  tile_ml],
+        out_specs=tile_q,
+        out_shape=sds((B * H, Tq, D), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
+        interpret=interpret,
+    )(q3, k3, v3, do3, lse3, dlt3)
+    # dk/dv: k-block is the 2nd grid axis, q streams as the minor axis
+    tile_q_minor = pl.BlockSpec((1, bq, D), lambda b, j, i: (b, i, 0))
+    tile_ml_minor = pl.BlockSpec((1, bq, 128), lambda b, j, i: (b, i, 0))
+    tile_k = pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _fa_bwd_dkv_kernel, scale=scale, block_q=bq, block_k=bk,
+            n_qb=n_qb, causal=causal, precision=precision,
+        ),
+        grid=(B * H, n_kb, n_qb),
+        in_specs=[tile_q_minor, tile_k, tile_k, tile_q_minor, tile_ml_minor,
+                  tile_ml_minor],
+        out_specs=[tile_k, tile_k],
+        out_shape=[
+            sds((B * H, Tk, D), k.dtype),
+            sds((B * H, Tk, D), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, D), jnp.float32),
+            pltpu.VMEM((bk, D), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q3, k3, v3, do3, lse3, dlt3)
+    reshape = lambda a, T: a.reshape(B, H, T, D).transpose(0, 2, 1, 3)
+    return reshape(dq, Tq), reshape(dk, Tk), reshape(dv, Tk)
+
+
 def _dense_f32(q, k, v, causal, prec=lax.Precision.HIGHEST):
     """Score/probability recompute used by the backward (plain XLA)."""
     scale = 1.0 / math.sqrt(q.shape[-1])
@@ -288,16 +508,20 @@ def _dense_f32(q, k, v, causal, prec=lax.Precision.HIGHEST):
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def flash_attention(q, k, v, causal=False, block_q=128, block_k=128,
+def flash_attention(q, k, v, causal=False, block_q=256, block_k=512,
                     interpret=None, precision="highest"):
-    """Tiled flash-attention forward on TPU (Pallas), differentiable.
+    """Tiled flash attention on TPU (Pallas), fwd AND bwd kernels.
 
     Shapes match :func:`parallel.attention.attention_reference`:
     q [B, Tq, H, D], k/v [B, Tk, H, D] → [B, Tq, H, D].
     ``interpret=None`` auto-selects the Pallas interpreter off-TPU.
     ``precision``: "highest" (true-f32 MXU passes, matches the dense
     reference bit-for-bit-ish) or "default" (bf16 MXU passes — the usual
-    flash-attention trade, ~1e-2 relative on f32 inputs, ~2x faster)."""
+    flash-attention trade, ~1e-2 relative on f32 inputs, ~2x faster).
+    Default blocks (256/512) are the measured fwd+bwd sweet spot (see
+    :func:`auto_block`); training memory is O(T) residuals (out + per-row
+    logsumexp) + O(block²) tiles — no [T, T] materialization in either
+    direction."""
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     prec = (
@@ -307,29 +531,30 @@ def flash_attention(q, k, v, causal=False, block_q=128, block_k=128,
 
 
 def _fa_fwd(q, k, v, causal, block_q, block_k, interpret, precision):
-    out = flash_attention(q, k, v, causal, block_q, block_k, interpret, precision)
-    return out, (q, k, v)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    prec = (
+        lax.Precision.HIGHEST if precision == "highest" else lax.Precision.DEFAULT
+    )
+    out, lse3 = _flash_forward(
+        q, k, v, causal, block_q, block_k, interpret, prec, with_lse=True
+    )
+    return out, (q, k, v, out, lse3)
 
 
 def _fa_bwd(causal, block_q, block_k, interpret, precision, res, do):
-    q, k, v = res
+    q, k, v, out, lse3 = res
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
     # honor the caller's precision trade in the backward too — it is the
     # dominant training cost, so "default" (bf16 MXU passes) must actually
     # apply here, not just in the forward kernel
     prec = (
         lax.Precision.HIGHEST if precision == "highest" else lax.Precision.DEFAULT
     )
-    p, scale = _dense_f32(q, k, v, causal, prec)    # [B,H,Tq,Tk]
-    do32 = do.astype(jnp.float32)
-    dv = jnp.einsum("bhqk,bqhd->bkhd", p, do32, precision=prec)
-    dp = jnp.einsum("bqhd,bkhd->bhqk", do32, v.astype(jnp.float32),
-                    precision=prec)
-    ds = p * (dp - (dp * p).sum(axis=-1, keepdims=True))
-    dq = scale * jnp.einsum("bhqk,bkhd->bqhd", ds, k.astype(jnp.float32),
-                            precision=prec)
-    dk = scale * jnp.einsum("bhqk,bqhd->bkhd", ds, q.astype(jnp.float32),
-                            precision=prec)
-    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+    return _flash_backward(
+        q, k, v, out, lse3, do, causal, block_q, block_k, interpret, prec
+    )
 
 
 flash_attention.defvjp(_fa_fwd, _fa_bwd)

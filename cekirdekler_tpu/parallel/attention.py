@@ -95,8 +95,10 @@ def ring_attention(q, k, v, axis: str, causal: bool = False,
     ``flash=True``: each ring step's block attention runs through the
     Pallas parts kernel (ops/flash_attention.py:flash_attention_parts,
     unnormalized accumulator + running max/denominator merged across
-    steps) instead of einsums — FORWARD ONLY (no VJP on the parts kernel;
-    training sticks with the einsum path).
+    steps) instead of einsums.  Differentiable: the flash ring carries a
+    custom_vjp whose backward is the einsum ring body's VJP (the parts
+    kernel itself has no VJP) — forward keeps the flash win, training
+    gets correct gradients at einsum-path cost.
     """
     if flash:
         from ..ops.flash_attention import auto_block
@@ -105,6 +107,10 @@ def ring_attention(q, k, v, axis: str, causal: bool = False,
             return _ring_attention_flash(q, k, v, axis, causal)
         # degenerate tiling (same convention as the ulysses flash path):
         # fall through to the einsum ring body
+    return _ring_attention_einsum(q, k, v, axis, causal)
+
+
+def _ring_attention_einsum(q, k, v, axis: str, causal: bool):
     n = lax.axis_size(axis)
     r = lax.axis_index(axis)
     B, Tq, H, D = q.shape
@@ -138,6 +144,7 @@ def ring_attention(q, k, v, axis: str, causal: bool = False,
     return out.transpose(0, 2, 1, 3).astype(q.dtype)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
 def _ring_attention_flash(q, k, v, axis: str, causal: bool):
     """Flash-inner ring body: per step the in-flight K/V block feeds the
     parts kernel with its GLOBAL position offset (the ring rotates
@@ -177,6 +184,25 @@ def _ring_attention_flash(q, k, v, axis: str, causal: bool):
     return (o / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)
 
 
+def _raf_fwd(q, k, v, axis, causal):
+    return _ring_attention_flash(q, k, v, axis, causal), (q, k, v)
+
+
+def _raf_bwd(axis, causal, res, do):
+    # the einsum ring computes the same function (stable softmax over the
+    # ring), so its VJP is the correct gradient; the parts kernel has no
+    # VJP of its own — without this, jax.grad died deep inside pallas_call
+    # with an opaque error (ADVICE r3 #2)
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda a, b, c: _ring_attention_einsum(a, b, c, axis, causal), q, k, v
+    )
+    return vjp(do)
+
+
+_ring_attention_flash.defvjp(_raf_fwd, _raf_bwd)
+
+
 def ulysses_attention(q, k, v, axis: str, causal: bool = False,
                       flash: bool = False):
     """Ulysses (all-to-all) sequence parallelism over ``axis`` (call inside
@@ -195,10 +221,11 @@ def ulysses_attention(q, k, v, axis: str, causal: bool = False,
     if flash:
         from ..ops.flash_attention import auto_block, flash_attention
 
-        blk = auto_block(q2.shape[1])
-        flash = blk is not None  # degenerate tiling → dense is faster
+        bq = auto_block(q2.shape[1], 256)
+        bk = auto_block(q2.shape[1], 512)
+        flash = bq is not None  # degenerate tiling → dense is faster
     if flash:
-        o2 = flash_attention(q2, k2, v2, causal, blk, blk)
+        o2 = flash_attention(q2, k2, v2, causal, bq, bk)
     else:
         o2 = attention_reference(q2, k2, v2, causal=causal)
     # head-sharded → seq-sharded

@@ -54,8 +54,15 @@ def test_flash_gradients_match_reference(causal):
         )
 
 
-def test_flash_rejects_bad_blocking():
+def test_flash_blocking_degrades_then_rejects():
+    # blocks degrade by gcd (48 with a 32 request -> 16-wide tiles) ...
     q, k, v = _qkv(Tq=48, Tk=48)
+    got = flash_attention(q, k, v, False, 32, 32, True)
+    want = attention_reference(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+    # ... but truly degenerate lengths (gcd < 8) still raise
+    q, k, v = _qkv(Tq=36, Tk=36)
     with pytest.raises(ValueError):
         flash_attention(q, k, v, False, 32, 32, True)
 
@@ -118,7 +125,8 @@ def test_transformer_flash_non_multiple_seq_len():
 def test_auto_block_degenerate_lengths():
     from cekirdekler_tpu.ops.flash_attention import auto_block
 
-    assert auto_block(2048) == 128
+    assert auto_block(2048) == 512   # default target: measured sweet spot
+    assert auto_block(2048, 128) == 128
     assert auto_block(200) == 8
     assert auto_block(999) is None   # odd: gcd 1 — degenerate
     assert auto_block(17) is None
