@@ -435,6 +435,13 @@ def main() -> None:
     # dense XLA attention, T=4096 f32 — same dependent-chain methodology.
     flash = section("flash_train", lambda: flash_train_faceoff())
 
+    # Marker overhead (r3 #7): per-dispatch host gap with fine-grained
+    # queue control off vs on (reference claim: 2-3 us -> 150-200 us per
+    # light kernel, ClNumberCruncher.cs:79).
+    from cekirdekler_tpu.workloads import marker_overhead
+
+    markers = section("marker_overhead", lambda: marker_overhead())
+
     result = {
         "metric": "mandelbrot_throughput",
         "value": round(full.mpixels_per_sec, 3),
@@ -466,6 +473,7 @@ def main() -> None:
         "balancer_rig": rig,
         "lowering_faceoff": faceoff,
         "flash_train": flash,
+        "marker_overhead": markers,
         "errors": errors,
         "note": (
             "vs_tuned_loop ~1.0 = no framework overhead over a hand-written "

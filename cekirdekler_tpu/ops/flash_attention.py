@@ -150,19 +150,37 @@ def _fa_kernel(*refs, scale, block_q, block_k, n_kb, causal, precision,
                 lse_ref[0] = jnp.broadcast_to(lse[:, None], lse_ref.shape[1:])
 
 
+
+def _resolve(interpret, precision):
+    """One place for the interpret default (Pallas interpreter off-TPU)
+    and the precision-string -> lax.Precision mapping — used by the
+    primal, parts, fwd, and bwd paths so they can never diverge."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    prec = (
+        lax.Precision.HIGHEST if precision == "highest"
+        else lax.Precision.DEFAULT
+    )
+    return interpret, prec
+
+
 def _blocks_for(Tq: int, Tk: int, block_q: int, block_k: int):
     """Effective (bq, bk): the largest divisors of the sequence lengths
     not exceeding the requested blocks (gcd) — so default-argument calls
     degrade gracefully for any T a smaller block would have handled
-    (e.g. T=640 with the 256 default -> 128), and only truly degenerate
-    lengths raise."""
+    (e.g. T=640 with the 256 default -> 128).  Degradation is bounded at
+    a quarter of the smaller requested block (floor 8): an awkward length
+    like T=4104 would gcd down to 8-wide tiles, a regime far slower than
+    the dense attention this replaces — raising loudly there beats
+    running silently at 100x cost."""
     bq = math.gcd(Tq, block_q)
     bk = math.gcd(Tk, block_k)
-    if bq < 8 or bk < 8:
+    floor = max(8, min(block_q, block_k) // 4)
+    if bq < floor or bk < floor:
         raise ValueError(
             f"sequence lengths (Tq={Tq}, Tk={Tk}) admit only degenerate "
-            f"tiles for requested blocks ({block_q}, {block_k}); use "
-            f"auto_block() or pad the sequence"
+            f"tiles ({bq}, {bk}) for requested blocks ({block_q}, "
+            f"{block_k}); use auto_block() or pad the sequence"
         )
     return bq, bk
 
@@ -255,11 +273,7 @@ def flash_attention_parts(
     only (no custom_vjp): training uses the einsum ring path."""
     from jax.experimental.pallas import tpu as pltpu
 
-    if interpret is None:
-        interpret = jax.default_backend() != "tpu"
-    prec = (
-        lax.Precision.HIGHEST if precision == "highest" else lax.Precision.DEFAULT
-    )
+    interpret, prec = _resolve(interpret, precision)
     B, Tq, H, D = q.shape
     Tk = k.shape[1]
     scale = 1.0 / math.sqrt(D)
@@ -522,20 +536,12 @@ def flash_attention(q, k, v, causal=False, block_q=256, block_k=512,
     :func:`auto_block`); training memory is O(T) residuals (out + per-row
     logsumexp) + O(block²) tiles — no [T, T] materialization in either
     direction."""
-    if interpret is None:
-        interpret = jax.default_backend() != "tpu"
-    prec = (
-        lax.Precision.HIGHEST if precision == "highest" else lax.Precision.DEFAULT
-    )
+    interpret, prec = _resolve(interpret, precision)
     return _flash_forward(q, k, v, causal, block_q, block_k, interpret, prec)
 
 
 def _fa_fwd(q, k, v, causal, block_q, block_k, interpret, precision):
-    if interpret is None:
-        interpret = jax.default_backend() != "tpu"
-    prec = (
-        lax.Precision.HIGHEST if precision == "highest" else lax.Precision.DEFAULT
-    )
+    interpret, prec = _resolve(interpret, precision)
     out, lse3 = _flash_forward(
         q, k, v, causal, block_q, block_k, interpret, prec, with_lse=True
     )
@@ -544,14 +550,10 @@ def _fa_fwd(q, k, v, causal, block_q, block_k, interpret, precision):
 
 def _fa_bwd(causal, block_q, block_k, interpret, precision, res, do):
     q, k, v, out, lse3 = res
-    if interpret is None:
-        interpret = jax.default_backend() != "tpu"
     # honor the caller's precision trade in the backward too — it is the
     # dominant training cost, so "default" (bf16 MXU passes) must actually
     # apply here, not just in the forward kernel
-    prec = (
-        lax.Precision.HIGHEST if precision == "highest" else lax.Precision.DEFAULT
-    )
+    interpret, prec = _resolve(interpret, precision)
     return _flash_backward(
         q, k, v, out, lse3, do, causal, block_q, block_k, interpret, prec
     )

@@ -54,12 +54,15 @@ class MarkerCounter:
             self._reached = 0
 
     def close(self) -> None:
-        """Stop the completion thread and release the native counter."""
+        """Stop the completion thread and release the native counter.
+        ``_closed`` makes the drain thread skip further device joins, so
+        the join below converges even when a burst of completions is
+        queued on a slow link."""
         self._closed = True
         t = self._completion_thread
         if t is not None:
             self._completions.put(None)
-            t.join(timeout=2.0)
+            t.join(timeout=5.0)
             self._completion_thread = None
         if self._nid is not None and self._native is not None:
             self._native.ck_deleteMarkerCounter(self._nid)
@@ -109,16 +112,42 @@ class MarkerCounter:
         self._completions.put((x, n))
 
     def _drain_completions(self) -> None:
+        # BATCHED joins: when several completions are queued, they are
+        # joined with ONE jax.block_until_ready over the whole batch (NOT
+        # only the newest item — transfer and compute streams of one
+        # device can retire out of order, so a single-item join would
+        # under-prove the batch).  Without batching, on a tunneled backend
+        # where every join costs ~1 RTT (~100 ms), the thread lags minutes
+        # behind a burst of light dispatches, remaining() wildly
+        # overestimates in-flight depth, and close()'s bounded join leaves
+        # an orphan thread to die inside PJRT teardown at interpreter exit
+        # (native terminate).  reach() is still called per item so the
+        # rate window keeps one sample per retired op.
         while True:
             item = self._completions.get()
             if item is None:
                 return
-            x, n = item
-            try:
-                x.block_until_ready()
-            except Exception:
-                pass  # a failed op still retires the marker
-            self.reach(n)
+            batch = [item]
+            while True:
+                try:
+                    nxt = self._completions.get_nowait()
+                except queue.Empty:
+                    break
+                if nxt is None:  # close() requested: finish batch, exit
+                    item = None
+                    break
+                batch.append(nxt)
+            if not self._closed:
+                try:
+                    import jax
+
+                    jax.block_until_ready([x for x, _ in batch])
+                except Exception:
+                    pass  # a failed op still retires its marker
+            for _, n in batch:
+                self.reach(n)
+            if item is None:
+                return
 
     # -- queries -------------------------------------------------------------
     @property

@@ -36,6 +36,7 @@ __all__ = [
     "convergence_iterations",
     "WAVE_SRC",
     "lowering_faceoff",
+    "marker_overhead",
 ]
 
 
@@ -780,4 +781,59 @@ def lowering_faceoff(
         "speedup": round(dt_x / dt_p, 2),
         "match": match,
     }
+    return out
+
+
+def marker_overhead(n: int = 4096, dispatches: int = 200) -> dict:
+    """Per-dispatch host gap with fine-grained markers OFF vs ON — the
+    reference quantifies this cost as 2-3 µs -> 150-200 µs per light
+    kernel (ClNumberCruncher.cs:79; Cores.cs:447 says 200-300 µs).
+
+    Methodology: a light kernel (tiny saxpy) dispatched ``dispatches``
+    times in enqueue mode (no per-call sync — the loop measures pure host
+    dispatch cost, which is what markers tax: every launch additionally
+    increments the native counter and enqueues a completion join).  One
+    barrier closes each run; its cost is excluded by timing only the
+    dispatch loop.  Reported per-dispatch, best of 3 runs each."""
+    from .hardware import all_devices
+
+    src = """
+    __kernel void light(__global float* x, __global float* y, float a) {
+        int i = get_global_id(0);
+        y[i] = a * x[i] + y[i];
+    }
+    """
+    devs = all_devices().tpus() or all_devices().cpus().subset(1)
+    x = ClArray(np.arange(n, dtype=np.float32), name="mx", read_only=True)
+    y = ClArray(n, np.float32, name="my", partial_read=True)
+    cr = NumberCruncher(devs, src)
+    out: dict = {"dispatches": dispatches}
+    try:
+        cr.enqueue_mode = True
+        for label, markers in (("markers_off", False), ("markers_on", True)):
+            cr.fine_grained_queue_control = markers
+            # warm (compile + caches), then measure the dispatch loop only
+            for _ in range(8):
+                x.next_param(y).compute(cr, 501, "light", n, 256, values=(1.0,))
+            cr.barrier()
+            best = float("inf")
+            for _ in range(3):
+                t0 = time.perf_counter()
+                for _ in range(dispatches):
+                    x.next_param(y).compute(
+                        cr, 501, "light", n, 256, values=(1.0,)
+                    )
+                dt = (time.perf_counter() - t0) / dispatches
+                cr.barrier()
+                best = min(best, dt)
+            out[label + "_us"] = round(best * 1e6, 1)
+            if markers:
+                cr.count_markers_remaining()  # exercise the query path
+        out["marker_cost_us"] = round(
+            out["markers_on_us"] - out["markers_off_us"], 1
+        )
+        out["reference_claim_us"] = "light-kernel gap 2-3 -> 150-200 (ClNumberCruncher.cs:79)"
+    finally:
+        cr.enqueue_mode = False
+        cr.dispose()
     return out
