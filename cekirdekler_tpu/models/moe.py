@@ -1,15 +1,27 @@
 """Mixture-of-experts FFN with expert parallelism (ep axis).
 
 Experts' weights shard over ``ep`` — each chip holds E/ep experts' params
-(the memory win expert parallelism exists for) and computes its experts'
-outputs for every token; a top-1 router gates, and a ``psum`` over ep
-combines.  Tokens are replicated across ep (they remain sharded over the
-data/sequence axes, which stay in GSPMD auto mode: ``axis_names={'ep'}``).
+(the memory win expert parallelism exists for).  Two formulations behind
+one signature:
 
-This is the dense ("compute-all, mask") formulation: simple, exactly
-differentiable, and correct for any router outcome; the all-to-all
-capacity-dispatch variant is the flop-optimal successor and slots in
-behind the same function signature.
+- **Dense compute-all** (:func:`moe_ffn`): every chip computes its
+  experts' outputs for EVERY token, a top-1 router gates, and a ``psum``
+  over ep combines.  Simple, exactly differentiable, correct for any
+  router outcome — and E_local× the FLOPs actually needed.
+- **Capacity dispatch** (:func:`moe_ffn_capacity`): the Switch-style
+  flop-optimal form.  Each chip GATHERS only the tokens routed to its
+  local experts into an [E_local, C, d] dispatch buffer (C = capacity),
+  runs the expert FFN on those, and SCATTERS the gated results back —
+  per-chip FFN FLOPs drop from T·E_local to C·E_local ≈ T·cap/E · E_local
+  (the expert-parallel flop win, realized).  Tokens beyond an expert's
+  capacity are dropped (the standard Switch trade); ``capacity_factor``
+  sizes the slack, and a factor ≥ E reproduces the dense result exactly
+  (nothing can overflow).
+
+Tokens are replicated across ep (they remain sharded over the data/
+sequence axes, which stay in GSPMD auto mode: ``axis_names={'ep'}``), so
+dispatch/combine are local gathers/scatters plus one psum — the
+"all-to-all" of token routing rides the same combine collective.
 """
 
 from __future__ import annotations
@@ -19,21 +31,27 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-__all__ = ["moe_ffn", "moe_ffn_sharded"]
+__all__ = ["moe_ffn", "moe_ffn_capacity", "moe_ffn_sharded"]
+
+
+def _route(x, router):
+    """Top-1 routing: (gate weight, expert index) per token."""
+    logits = x.astype(jnp.float32) @ router.astype(jnp.float32)  # [B,T,E]
+    gate_all = jax.nn.softmax(logits, axis=-1)
+    idx = jnp.argmax(logits, axis=-1)                            # [B,T]
+    g = jnp.take_along_axis(gate_all, idx[..., None], axis=-1)[..., 0]
+    return g, idx
 
 
 def moe_ffn(x, router, w1, w2, axis: str | None = None):
-    """Top-1 routed expert FFN.
+    """Top-1 routed expert FFN, dense compute-all formulation.
 
     x [B,T,d]; router [d,E]; w1 (local) [E_local,d,f]; w2 [E_local,f,d].
     With ``axis`` bound (inside shard_map) E_local = E/ep and results
     psum-combine; with ``axis=None`` w1/w2 hold all experts.
     """
     dt = x.dtype
-    logits = (x.astype(jnp.float32) @ router.astype(jnp.float32))  # [B,T,E]
-    gate_all = jax.nn.softmax(logits, axis=-1)
-    idx = jnp.argmax(logits, axis=-1)                              # [B,T]
-    g = jnp.take_along_axis(gate_all, idx[..., None], axis=-1)[..., 0]
+    g, idx = _route(x, router)
 
     e0 = lax.axis_index(axis) * w1.shape[0] if axis is not None else 0
     h = jnp.einsum("btd,edf->ebtf", x, w1.astype(dt))
@@ -47,12 +65,87 @@ def moe_ffn(x, router, w1, w2, axis: str | None = None):
     return y.astype(dt)
 
 
-def moe_ffn_sharded(mesh: Mesh, x, router, w1, w2, axis: str = "ep"):
+def moe_ffn_capacity(x, router, w1, w2, axis: str | None = None,
+                     capacity_factor: float = 2.0):
+    """Top-1 routed expert FFN, capacity-dispatch formulation.
+
+    Same signature/contract as :func:`moe_ffn` plus ``capacity_factor``:
+    per-expert capacity C = ceil(N/E · capacity_factor) (N = B·T tokens,
+    E = global expert count).  Tokens overflowing an expert's capacity
+    contribute zero (dropped — Switch Transformer semantics); a factor
+    ≥ E makes dropping impossible and the result matches :func:`moe_ffn`
+    exactly.  Differentiable: gradients flow through the gate weights and
+    the expert computation via the gather/scatter (argmax routing itself
+    is non-differentiable in both formulations).
+    """
+    dt = x.dtype
+    B, T, d = x.shape
+    El = w1.shape[0]
+    nshards = lax.axis_size(axis) if axis is not None else 1
+    E = El * nshards
+    N = B * T
+    C = int(max(1, -(-N * capacity_factor // E)))
+    e0 = lax.axis_index(axis) * El if axis is not None else 0
+
+    g, idx = _route(x, router)
+    xf = x.reshape(N, d)
+    gf = g.reshape(N)
+    idxf = idx.reshape(N)
+
+    # position of each token within its expert's queue (0-based), computed
+    # over the GLOBAL expert id so every chip agrees on slot assignment
+    oh = jax.nn.one_hot(idxf, E, dtype=jnp.int32)                # [N,E]
+    pos = jnp.cumsum(oh, axis=0) * oh - oh                       # 0-based at hit
+    pos_t = pos.sum(axis=1)                                      # [N]
+    keep = pos_t < C
+
+    # local slot id for tokens routed to THIS chip's experts; everything
+    # else (other chips' tokens, overflow) is redirected out of bounds and
+    # dropped by the scatter
+    local_e = idxf - e0
+    mine = (local_e >= 0) & (local_e < El) & keep
+    slot = jnp.where(mine, local_e * C + pos_t, El * C)          # [N]
+
+    # dispatch[e*C + c] = token id occupying that slot (N = empty slot)
+    dispatch = jnp.full((El * C + 1,), N, jnp.int32)
+    dispatch = dispatch.at[slot].set(
+        jnp.arange(N, dtype=jnp.int32), mode="drop"
+    )[: El * C]
+
+    # gather tokens (empty slots read a zero row via the padded x)
+    xpad = jnp.concatenate([xf, jnp.zeros((1, d), dt)], axis=0)
+    xe = xpad[dispatch].reshape(El, C, d)                        # [El,C,d]
+
+    h = jax.nn.gelu(jnp.einsum("ecd,edf->ecf", xe, w1.astype(dt)))
+    o = jnp.einsum("ecf,efd->ecd", h, w2.astype(dt))             # [El,C,d]
+
+    # combine: scatter gated outputs back to token order
+    gpad = jnp.concatenate([gf, jnp.zeros((1,), jnp.float32)])
+    oflat = o.reshape(El * C, d).astype(jnp.float32) * gpad[dispatch][:, None]
+    y = jnp.zeros((N + 1, d), jnp.float32).at[dispatch].add(
+        oflat, mode="drop"
+    )[:N]
+    if axis is not None:
+        y = lax.psum(y, axis)
+    return y.reshape(B, T, d).astype(dt)
+
+
+def moe_ffn_sharded(mesh: Mesh, x, router, w1, w2, axis: str = "ep",
+                    capacity_factor: float = 0.0):
     """shard_map wrapper: w1/w2 are global [E,d,f]/[E,f,d] sharded on dim 0
     over ``axis``; x and router replicated over it (their other shardings
-    stay auto)."""
+    stay auto).  ``capacity_factor > 0`` selects the capacity-dispatch
+    formulation; 0 keeps dense compute-all."""
+    if capacity_factor > 0:
+        def body(xx, r, a, b):
+            return moe_ffn_capacity(
+                xx, r, a, b, axis=axis, capacity_factor=capacity_factor
+            )
+    else:
+        def body(xx, r, a, b):
+            return moe_ffn(xx, r, a, b, axis=axis)
     fn = jax.shard_map(
-        lambda xx, r, a, b: moe_ffn(xx, r, a, b, axis=axis),
+        body,
         mesh=mesh,
         in_specs=(P(), P(), P(axis), P(axis)),
         out_specs=P(),

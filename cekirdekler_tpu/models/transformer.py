@@ -48,9 +48,13 @@ class TransformerConfig:
     remat: bool = False
     sp_axis: str = "sp"
     # mixture of experts: n_experts > 0 turns every ``moe_every``-th block's
-    # FFN into a top-1 routed expert layer (experts shard over ep)
+    # FFN into a top-1 routed expert layer (experts shard over ep).
+    # moe_capacity_factor > 0 selects Switch-style capacity dispatch
+    # (per-chip FFN flops ~ cap/E of compute-all; over-capacity tokens
+    # drop); 0 keeps the dense compute-all formulation (exact)
     n_experts: int = 0
     moe_every: int = 2
+    moe_capacity_factor: float = 0.0
     # pipeline parallelism: pp_stages > 1 stacks the blocks and runs them
     # GPipe-style over the pp axis with n_microbatches per step
     pp_stages: int = 1
@@ -217,10 +221,15 @@ class Transformer:
         x = x + o
         h = _rms_norm(x, params["ln2"])
         if "router" in params:
-            from .moe import moe_ffn, moe_ffn_sharded
+            from .moe import moe_ffn, moe_ffn_capacity, moe_ffn_sharded
 
+            cf = c.moe_capacity_factor
             if mesh is not None:
-                h = moe_ffn_sharded(mesh, h, params["router"], params["w1"], params["w2"])
+                h = moe_ffn_sharded(mesh, h, params["router"], params["w1"],
+                                    params["w2"], capacity_factor=cf)
+            elif cf > 0:
+                h = moe_ffn_capacity(h, params["router"], params["w1"],
+                                     params["w2"], capacity_factor=cf)
             else:
                 # under pp (or single device) GSPMD auto-shards the expert
                 # dim from the param shardings

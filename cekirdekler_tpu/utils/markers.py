@@ -92,7 +92,11 @@ class MarkerCounter:
             with self._lock:
                 self._reached += n
         with self._lock:
-            self._times.append(now)
+            # (time, count) samples: batched retirement observations carry
+            # their op count, so reach_speed() stays ops/second — n bunched
+            # reach() calls would otherwise compress the window span and
+            # inflate the rate by orders of magnitude
+            self._times.append((now, n))
 
     def reach_when_ready(self, x, n: int = 1) -> None:
         """Reach when ``x`` (a jax.Array or any object with
@@ -143,7 +147,14 @@ class MarkerCounter:
 
                     jax.block_until_ready([x for x, _ in batch])
                 except Exception:
-                    pass  # a failed op still retires its marker
+                    # one poisoned op must not retire the REST of the batch
+                    # early (block_until_ready raises on the first failure
+                    # before joining the others): join the rest one by one
+                    for x, _ in batch:
+                        try:
+                            x.block_until_ready()
+                        except Exception:
+                            pass  # a failed op still retires its marker
             for _, n in batch:
                 self.reach(n)
             if item is None:
@@ -178,12 +189,15 @@ class MarkerCounter:
             time.sleep(0.0005)
 
     def reach_speed(self) -> float:
-        """Retired ops/second over the smoothing window (0 if <2 samples)."""
+        """Retired ops/second over the smoothing window (0 if <2 samples):
+        ops counted from the second observation on, over the window span —
+        each sample may represent a batch of retirements."""
         with self._lock:
             if len(self._times) < 2:
                 return 0.0
-            span = self._times[-1] - self._times[0]
-            return (len(self._times) - 1) / span if span > 0 else 0.0
+            span = self._times[-1][0] - self._times[0][0]
+            ops = sum(n for _, n in list(self._times)[1:])
+            return ops / span if span > 0 else 0.0
 
     def reset(self) -> None:
         if self._nid is not None:

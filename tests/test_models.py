@@ -200,3 +200,91 @@ def test_checkpoint_resume_is_deterministic(tmp_path):
         p2, o2, loss = step(p2, o2, b)
         resumed.append(float(loss))
     np.testing.assert_allclose(resumed, losses[2:], rtol=1e-6)
+
+
+def test_moe_capacity_matches_dense_when_ample():
+    """capacity_factor >= E makes dropping impossible: the capacity
+    dispatch must reproduce the dense compute-all result exactly, both
+    single-device and on the dp x ep mesh."""
+    from cekirdekler_tpu.models.moe import moe_ffn, moe_ffn_capacity, moe_ffn_sharded
+
+    rng = np.random.default_rng(7)
+    B, T, d, f, E = 2, 16, 32, 64, 4
+    x = jnp.asarray(rng.standard_normal((B, T, d)), jnp.float32)
+    router = jnp.asarray(rng.standard_normal((d, E)) * 0.1, jnp.float32)
+    w1 = jnp.asarray(rng.standard_normal((E, d, f)) * 0.1, jnp.float32)
+    w2 = jnp.asarray(rng.standard_normal((E, f, d)) * 0.1, jnp.float32)
+    want = moe_ffn(x, router, w1, w2)
+    got = moe_ffn_capacity(x, router, w1, w2, capacity_factor=float(E))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+    mesh = par.make_mesh(jax.devices("cpu")[:4], ep=4)
+    got_sh = moe_ffn_sharded(mesh, x, router, w1, w2,
+                             capacity_factor=float(E))
+    np.testing.assert_allclose(np.asarray(got_sh), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_moe_capacity_drops_overflow_tokens():
+    """With capacity 1 token per expert, the FIRST token routed to each
+    expert keeps its output and later ones contribute zero."""
+    from cekirdekler_tpu.models.moe import moe_ffn_capacity
+
+    rng = np.random.default_rng(8)
+    B, T, d, f, E = 1, 8, 16, 32, 2
+    x = jnp.asarray(rng.standard_normal((B, T, d)), jnp.float32)
+    # zero router: all logits tie, argmax picks expert 0 for every token
+    router = jnp.zeros((d, E), jnp.float32)
+    w1 = jnp.asarray(rng.standard_normal((E, d, f)) * 0.1, jnp.float32)
+    w2 = jnp.asarray(rng.standard_normal((E, f, d)) * 0.1, jnp.float32)
+    # capacity_factor 2/E -> C = ceil(N/E * 2/E)... pick factor so C=1:
+    # N=8, E=2 -> C = ceil(4 * cf); cf=0.25 -> C=1
+    y = moe_ffn_capacity(x, router, w1, w2, capacity_factor=0.25)
+    y = np.asarray(y)
+    assert np.abs(y[0, 0]).max() > 0  # first token kept
+    assert np.abs(y[0, 1:]).max() == 0  # the rest dropped
+
+
+def test_moe_capacity_gradients_flow():
+    from cekirdekler_tpu.models.moe import moe_ffn_capacity
+
+    rng = np.random.default_rng(9)
+    B, T, d, f, E = 2, 8, 16, 32, 4
+    x = jnp.asarray(rng.standard_normal((B, T, d)), jnp.float32)
+    router = jnp.asarray(rng.standard_normal((d, E)) * 0.1, jnp.float32)
+    w1 = jnp.asarray(rng.standard_normal((E, d, f)) * 0.1, jnp.float32)
+    w2 = jnp.asarray(rng.standard_normal((E, f, d)) * 0.1, jnp.float32)
+    g = jax.grad(lambda w1, w2: (
+        moe_ffn_capacity(x, router, w1, w2, capacity_factor=2.0) ** 2).sum(),
+        argnums=(0, 1))(w1, w2)
+    assert all(np.isfinite(np.asarray(a)).all() for a in g)
+    assert any(np.abs(np.asarray(a)).max() > 0 for a in g)
+
+
+def test_moe_capacity_flop_win_on_ep_mesh():
+    """The VERDICT r3 #8 criterion: lowered per-step FLOPs of the
+    capacity formulation beat dense compute-all at E>=4 on the 8-device
+    ep mesh."""
+    from cekirdekler_tpu.models.moe import moe_ffn_sharded
+
+    rng = np.random.default_rng(10)
+    B, T, d, f, E = 4, 64, 64, 256, 8
+    x = jnp.asarray(rng.standard_normal((B, T, d)), jnp.float32)
+    router = jnp.asarray(rng.standard_normal((d, E)) * 0.1, jnp.float32)
+    w1 = jnp.asarray(rng.standard_normal((E, d, f)) * 0.1, jnp.float32)
+    w2 = jnp.asarray(rng.standard_normal((E, f, d)) * 0.1, jnp.float32)
+    mesh = par.make_mesh(jax.devices("cpu")[:8], ep=8)
+
+    def flops(cf):
+        fn = jax.jit(lambda *a: moe_ffn_sharded(mesh, *a, capacity_factor=cf))
+        lowered = fn.lower(x, router, w1, w2).compile()
+        c = lowered.cost_analysis()
+        c = c[0] if isinstance(c, (list, tuple)) else c
+        return float(c.get("flops", 0.0))
+
+    dense, cap = flops(0.0), flops(2.0)
+    assert dense > 0 and cap > 0
+    # dense does T*E_local expert-ffn work per chip; capacity does C*E_local
+    # with C = T*cf/E -> expect ~E/cf = 4x fewer total flops (allow slack
+    # for routing/scatter overhead)
+    assert cap < dense / 2, (dense, cap)
