@@ -401,6 +401,13 @@ def main() -> None:
     ovb = section("overlap_balanced", lambda: measure_stream_overlap(
         devs, n=1 << 22, blobs=8, reps=5, heavy_iters=30000))
 
+    # The physical ceiling those ratios must be judged against (r3 #2):
+    # pure H2D || D2H with no compute.  A half-duplex host link caps
+    # transfer-direction overlap regardless of engine scheduling.
+    from cekirdekler_tpu.workloads import duplex_ceiling
+
+    duplex = section("duplex_ceiling", lambda: duplex_ceiling())
+
     # Roofline accounting.
     mean_iters = float(np.mean(full.image)) if full.image is not None else max_iter / 4
     gflops = full.mpixels_per_sec * 1e6 * mean_iters * FLOP_PER_MANDEL_ITER / 1e9
@@ -460,6 +467,10 @@ def main() -> None:
         "timeline": tl,
         "overlap_transfer_bound_raw": round(ov["overlap_fraction"], 4) if ov else None,
         "overlap_balanced_raw": round(ovb["overlap_fraction"], 4) if ovb else None,
+        "duplex_ceiling": duplex,
+        "overlap_transfer_vs_ceiling": round(
+            ov["overlap_fraction"] / duplex["ceiling"], 3
+        ) if ov and duplex and duplex.get("ceiling", 0) > 0 else None,
         "overlap_detail_ms": _overlap_detail(ov) if ov else None,
         "overlap_balanced_detail_ms": _overlap_detail(ovb) if ovb else None,
         "mean_escape_iters": round(mean_iters, 2),

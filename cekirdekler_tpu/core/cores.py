@@ -93,6 +93,11 @@ class Cores:
         self.repeat_sync_kernel: str | None = None
         self.enqueue_mode = False
         self.no_compute_mode = False  # I/O only (reference: noComputeMode)
+        # EVENT-engine read lookahead depth (blobs staged ahead of the
+        # compute stage): 1 = the reference's 3-queue wavefront; deeper
+        # keeps the inbound DMA lane busy when one blob's transfer
+        # outlasts one compute step
+        self.pipeline_lookahead = 2
         self._enqueued: list[tuple[Worker, ClArray, int, int, bool]] = []
         self._lock = threading.Lock()
         self.last_compute_id: int | None = None
@@ -573,19 +578,24 @@ class Cores:
         single: bool,
         write_all_owner: dict[int, int],
     ) -> None:
-        """EVENT engine: breadth-first 3-stage wavefront — at step j the
-        host *stages* blob j's H2D DMA (transfer starts immediately, no
-        device-side insert yet), *commits + computes* blob j-1, and starts
-        blob j-2's D2H (reference: the event-driven 3-queue pipeline whose
-        read/compute/write queues chain per-blob events,
-        Cores.cs:1236-1367).  Explicit dependency chaining: the commit
-        (dynamic_update_slice of the staged slice) is the device-side edge
-        from the read stage into the compute stage, so blob j's DMA always
-        has a full compute-step of latency to hide behind blob j-1's
-        kernels."""
+        """EVENT engine: breadth-first 3-stage wavefront with a
+        configurable read lookahead L (``pipeline_lookahead``, default 2) —
+        at step j the host *stages* blob j's H2D DMA (transfer starts
+        immediately, no device-side insert yet), *commits + computes* blob
+        j-L, and starts blob j-L-1's D2H (reference: the event-driven
+        3-queue pipeline whose read/compute/write queues chain per-blob
+        events, Cores.cs:1236-1367).  Explicit dependency chaining: the
+        commit (dynamic_update_slice of the staged slice) is the
+        device-side edge from the read stage into the compute stage, so
+        blob j's DMA always has L compute-steps of latency to hide behind
+        — a deeper lookahead keeps the inbound DMA lane busy even when a
+        single blob's transfer outlasts one compute step (the r3 overlap
+        shortfall), at the cost of up to L+1 simultaneously staged blobs
+        of host/HBM footprint (blob j is staged before blob j-L pops)."""
         blob = size // blobs
         if blob <= 0:
             blob, blobs = size, 1
+        look = max(1, int(self.pipeline_lookahead))
         resident = self._pipeline_prologue(w, params, offset, size)
         partials = [
             p
@@ -602,7 +612,7 @@ class Cores:
         ]
         staged: dict[int, list] = {}
         handles = []
-        for j in range(blobs + 2):
+        for j in range(blobs + look + 1):
             if j < blobs:  # read stage: start blob j's DMA
                 boff = offset + j * blob
                 staged[j] = [
@@ -613,7 +623,7 @@ class Cores:
                     )
                     for p in partials
                 ]
-            k = j - 1
+            k = j - look
             if 0 <= k < blobs:  # compute stage: commit blob k, launch kernels
                 for s in staged.pop(k, ()):
                     w.commit_upload(s)
@@ -624,7 +634,7 @@ class Cores:
                         local_range, repeats=self.repeat_count,
                         sync_kernel=self.repeat_sync_kernel,
                     )
-            m = j - 2
+            m = j - look - 1
             if 0 <= m < blobs and not self.enqueue_mode:  # write stage
                 boff = offset + m * blob
                 for idx, p in writers:

@@ -100,6 +100,16 @@ class NumberCruncher:
         self.cores.no_compute_mode = bool(v)
 
     @property
+    def pipeline_lookahead(self) -> int:
+        """EVENT-engine read lookahead depth (blobs staged ahead of
+        compute; 1 = the reference's 3-queue wavefront)."""
+        return self.cores.pipeline_lookahead
+
+    @pipeline_lookahead.setter
+    def pipeline_lookahead(self, v: int) -> None:
+        self.cores.pipeline_lookahead = max(1, int(v))
+
+    @property
     def performance_feed(self) -> bool:
         return self.cores.performance_feed
 

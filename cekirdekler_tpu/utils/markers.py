@@ -41,7 +41,9 @@ class MarkerCounter:
 
     def __init__(self, window: int = 15):
         self._lock = threading.Lock()
-        self._times: deque[float] = deque(maxlen=window)
+        # (retire-observation time, op count) — batched observations carry
+        # their op count so reach_speed() stays ops/second
+        self._times: deque[tuple[float, int]] = deque(maxlen=window)
         self._completions: "queue.Queue" = queue.Queue()
         self._completion_thread: threading.Thread | None = None
         self._closed = False
@@ -155,8 +157,10 @@ class MarkerCounter:
                             x.block_until_ready()
                         except Exception:
                             pass  # a failed op still retires its marker
-            for _, n in batch:
-                self.reach(n)
+            # ONE weighted rate sample for the whole batch: per-item
+            # reach() calls would bunch the window into microseconds and
+            # inflate reach_speed() by orders of magnitude
+            self.reach(sum(n for _, n in batch))
             if item is None:
                 return
 

@@ -37,6 +37,7 @@ __all__ = [
     "WAVE_SRC",
     "lowering_faceoff",
     "marker_overhead",
+    "duplex_ceiling",
 ]
 
 
@@ -837,3 +838,82 @@ def marker_overhead(n: int = 4096, dispatches: int = 200) -> dict:
         cr.enqueue_mode = False
         cr.dispose()
     return out
+
+
+def duplex_ceiling(n: int = 1 << 22, reps: int = 3) -> dict:
+    """Host-link duplex capacity: pure H2D ∥ D2H with NO compute, against
+    each direction alone — the physical ceiling for read/write overlap
+    that the pipeline engines can never beat (VERDICT r3 #2: if this is
+    < 0.9, achieved overlap must be judged against IT, not against 1.0).
+
+    ceiling = (h2d + d2h - duplex) / (h2d + d2h - max(h2d, d2h)):
+    1.0 = the link runs both directions concurrently at full rate;
+    0.0 = fully serial link.  Fresh values every rep (a mutated host
+    array for H2D, a freshly computed device array for D2H) so no
+    transport/runtime cache can elide a transfer; RTT subtracted."""
+    import jax
+    import jax.numpy as jnp
+
+    dev = jax.devices()[0]
+    host_a = np.arange(n, dtype=np.float32)
+    base = jax.device_put(jnp.zeros(n, jnp.float32), dev)
+    jax.block_until_ready(base)
+    probe = jax.device_put(np.zeros(8, np.float32), dev)
+
+    def fence():
+        np.asarray(probe[:1])
+
+    rtt = min(
+        (lambda t0: (fence(), time.perf_counter() - t0)[1])(time.perf_counter())
+        for _ in range(5)
+    )
+    k = [0]
+
+    def fresh_host():
+        k[0] += 1
+        host_a[0] = k[0]
+        return host_a
+
+    def fresh_dev():
+        k[0] += 1
+        y = base + np.float32(k[0])
+        jax.block_until_ready(y)
+        return y
+
+    def t_h2d_once():
+        h = fresh_host()
+        t0 = time.perf_counter()
+        jax.block_until_ready(jax.device_put(h, dev))
+        return time.perf_counter() - t0 - rtt
+
+    def t_d2h_once():
+        y = fresh_dev()
+        t0 = time.perf_counter()
+        np.asarray(y)
+        return time.perf_counter() - t0 - rtt
+
+    def t_duplex_once():
+        y = fresh_dev()
+        h = fresh_host()
+        t0 = time.perf_counter()
+        x = jax.device_put(h, dev)  # async H2D
+        np.asarray(y)               # D2H
+        jax.block_until_ready(x)
+        return time.perf_counter() - t0 - rtt
+
+    h2d = min(t_h2d_once() for _ in range(reps))
+    d2h = min(t_d2h_once() for _ in range(reps))
+    dup = min(t_duplex_once() for _ in range(reps))
+    denom = h2d + d2h - max(h2d, d2h)
+    ceiling = (h2d + d2h - dup) / denom if denom > 0 else 0.0
+    gb = n * 4 / 1e9
+    return {
+        "h2d_ms": round(h2d * 1e3, 1),
+        "d2h_ms": round(d2h * 1e3, 1),
+        "duplex_ms": round(dup * 1e3, 1),
+        "h2d_gbps": round(gb / max(h2d, 1e-9), 3),
+        "d2h_gbps": round(gb / max(d2h, 1e-9), 3),
+        "ceiling": round(ceiling, 3),
+        "rtt_ms": round(rtt * 1e3, 1),
+        "bytes": n * 4,
+    }

@@ -587,3 +587,29 @@ def test_concurrent_fence_during_compute(devs):
         t.join(timeout=30.0)
     assert not errors, errors
     cr.dispose()
+
+
+def test_event_pipeline_lookahead_depths_exact(devs):
+    """The EVENT engine must stay exact at every read-lookahead depth
+    (1 = the reference's wavefront, deeper = r4 DMA-latency hiding)."""
+    n = 4096
+    src = """
+    __kernel void sa(__global float* a, __global float* b, __global float* c) {
+        int i = get_global_id(0);
+        c[i] = a[i] + 2.0f * b[i];
+    }
+    """
+    av = np.arange(n, dtype=np.float32)
+    bv = (np.arange(n, dtype=np.float32) % 13)
+    want = av + 2.0 * bv
+    for look in (1, 2, 4):
+        cr = NumberCruncher(devs.subset(2), src)
+        cr.pipeline_lookahead = look
+        a = ClArray(av.copy(), name="la", partial_read=True, read_only=True)
+        b = ClArray(bv.copy(), name="lb", partial_read=True, read_only=True)
+        c = ClArray(n, np.float32, name="lc", write_only=True)
+        a.next_param(b, c).compute(
+            cr, 601 + look, "sa", n, 128, pipeline=True, pipeline_blobs=8)
+        np.testing.assert_allclose(c.host(), want, rtol=1e-6,
+                                   err_msg=f"lookahead={look}")
+        cr.dispose()
