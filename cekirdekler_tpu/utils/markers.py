@@ -127,8 +127,8 @@ class MarkerCounter:
         # behind a burst of light dispatches, remaining() wildly
         # overestimates in-flight depth, and close()'s bounded join leaves
         # an orphan thread to die inside PJRT teardown at interpreter exit
-        # (native terminate).  reach() is still called per item so the
-        # rate window keeps one sample per retired op.
+        # (native terminate).  The whole batch retires as ONE weighted
+        # rate sample (see below).
         while True:
             item = self._completions.get()
             if item is None:
