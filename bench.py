@@ -12,7 +12,7 @@ Accounting:
   D2H + host sync per iteration) — the r1-continuity number; it mostly
   measures what the enqueue/overlap machinery removes.
 - ``vs_tuned_loop``: framework vs a HAND-WRITTEN jit'd Pallas loop with the
-  SAME readback policy (image resident in HBM, fence every 16 iters).
+  SAME readback policy (image resident in HBM, fence every 32 iters).
   ~1.0 means the framework's scheduling adds no overhead over the best
   raw-JAX loop a user could write (VERDICT r2 #2 target: >= 0.9).
 - ``repeat_mode_mpix``: the framework's on-device repeat (computeRepeated
@@ -334,9 +334,22 @@ def main() -> None:
     # line — a transient tunnel failure in one measurement reports as that
     # section's error, not an empty artifact (this happened once: one
     # assert took the whole bench down with no output).
+    #
+    # Soft time budget: tunnel bandwidth drifts by 100x between days; on a
+    # bad day the full suite would outlive any driver timeout and deliver
+    # NOTHING.  Once the budget is spent, remaining sections are skipped
+    # (recorded as such) — a partial artifact beats a dead one.  Override
+    # with CK_BENCH_BUDGET_SEC.
     errors: dict = {}
+    t_start = time.monotonic()
+    budget = float(os.environ.get("CK_BENCH_BUDGET_SEC", "1500"))
 
-    def section(name, fn, default=None):
+    def section(name, fn, default=None, critical=False):
+        # the headline path (tuned_loop/framework) is exempt: a 0.0
+        # headline is worse than a late artifact
+        if not critical and time.monotonic() - t_start > budget:
+            errors[name] = f"skipped: {budget:.0f}s bench budget spent"
+            return default
         try:
             return fn()
         except Exception as e:  # noqa: BLE001 - resilience boundary
@@ -354,16 +367,17 @@ def main() -> None:
     # the framework path below.
     tuned_mpix = section("tuned_loop", lambda: tuned_pallas_loop(
         devs[0].jax_device, width, height, max_iter, iters=32, warmup=4,
-    )[0], default=0.0)
+        sync_every=32,
+    )[0], default=0.0, critical=True)
 
     # Framework path: hand-tiled Pallas kernel through the compute()
     # scheduler, enqueue mode keeps the image in HBM (one flush at the
     # end), 16-deep dispatch chains amortize sync latency.
     full = section("framework", lambda: run_mandelbrot(
         devs, width=width, height=height, max_iter=max_iter,
-        iters=32, warmup=4, use_pallas=True, readback="final", sync_every=16,
+        iters=32, warmup=4, use_pallas=True, readback="final", sync_every=32,
         keep_image=True,
-    ))
+    ), critical=True)
     if full is None:  # headline measurement is not optional
         print(json.dumps({
             "metric": "mandelbrot_throughput", "value": 0.0,
@@ -376,7 +390,7 @@ def main() -> None:
     # replacement that is the product's core claim) — same readback policy.
     cg = section("codegen", lambda: run_mandelbrot(
         devs.subset(1), width=width, height=height, max_iter=max_iter,
-        iters=32, warmup=4, use_pallas=False, readback="final", sync_every=16,
+        iters=32, warmup=4, use_pallas=False, readback="final", sync_every=32,
     ))
 
     # On-device repeat: computeRepeated parity, one dispatch per 32 images.
@@ -400,7 +414,7 @@ def main() -> None:
     ov = section("overlap", lambda: measure_stream_overlap(
         devs, n=1 << 22, blobs=8, reps=5))
     ovb = section("overlap_balanced", lambda: measure_stream_overlap(
-        devs, n=1 << 22, blobs=8, reps=5, heavy_iters=30000))
+        devs, n=1 << 22, blobs=8, reps=5, heavy_iters="auto"))
 
     # The physical ceiling those ratios must be judged against (r3 #2):
     # pure H2D || D2H with no compute.  A half-duplex host link caps
@@ -417,12 +431,16 @@ def main() -> None:
     )
     hbm_util = hbm_gbps / V5E_HBM_GBPS
 
-    # The reference's flagship numeric workload (Tester.nBody), fused-XLA
-    # fast path, self-checked vs the host O(n^2) reference.
+    # The reference's flagship numeric workload (Tester.nBody) through the
+    # compute() harness, self-checked vs the host O(n^2) reference.  Runs
+    # the C-SUBSET kernel: since the r4 Pallas uniform-gather path it is
+    # the fastest formulation (~25x its XLA lowering, 2-3x the hand-written
+    # jnp path at device level — see lowering_faceoff.nbody for the
+    # harness-free number; this one includes scheduler+transfer+sync).
     from cekirdekler_tpu.workloads import run_nbody
 
     nb = section("nbody", lambda: run_nbody(
-        devs.subset(1), n=8192, iters=6, check=True, use_jnp=True,
+        devs.subset(1), n=8192, iters=6, check=True, use_jnp=False,
     ), default={"gpairs_per_sec": 0.0, "checked": False})
 
     # Balancer on the 8-device rig with skewed per-range load (r2 #4).
