@@ -309,7 +309,7 @@ def balancer_rig_section():
 
 _OVERLAP_KEYS = (
     "t_read_ms", "t_compute_ms", "t_write_ms", "t_pipelined_ms",
-    "rtt_ms", "sample_spread",
+    "rtt_ms", "sample_spread", "heavy_iters",
 )
 
 
@@ -444,7 +444,7 @@ def main() -> None:
     ), default={"gpairs_per_sec": 0.0, "checked": False})
 
     # Balancer on the 8-device rig with skewed per-range load (r2 #4).
-    rig = balancer_rig_section()
+    rig = section("balancer_rig", balancer_rig_section)
 
     # Lowering faceoff (r3 #3): XLA vs Pallas lowering of the SAME kernel-
     # language programs at device throughput — dependent-chain timing, one
