@@ -190,18 +190,37 @@ class Transformer:
                 out_specs=spec,
             )
             return fn(q, k, v)
-        if c.attention == "flash" and mesh is None:
-            # single-chip Pallas hot op (ops/flash_attention.py): tiled
-            # stable-softmax, O(block²) attention memory, differentiable.
-            # Under a mesh this falls through to the GSPMD-partitionable
-            # dense path instead — pallas_call cannot be auto-partitioned,
-            # and the sequence/tensor-parallel forms are ring/ulysses.
+        if c.attention == "flash":
+            # Pallas hot op (ops/flash_attention.py): tiled stable-softmax,
+            # O(block²) attention memory, fwd+bwd kernels, differentiable.
             from ..ops.flash_attention import auto_block, flash_attention
 
             bq = auto_block(q.shape[1], 256)
             bk = auto_block(q.shape[1], 512)
-            if bq is not None:  # degenerate tiling → dense is faster
+            if bq is not None and mesh is None:
                 return flash_attention(q, k, v, True, bq, bk)
+            if bq is not None and mesh.shape.get(c.sp_axis, 1) <= 1:
+                # batch-sharded mesh (dp/fsdp; heads optionally over tp):
+                # causal self-attention is independent per (batch, head),
+                # so each shard runs the SAME Pallas kernel on its local
+                # slice under shard_map — pallas_call cannot be
+                # auto-partitioned by GSPMD, but it doesn't need to be
+                # when no sharded axis crosses the attention reduction.
+                # Sequence-sharded meshes use ring/ulysses instead.
+                # interpret follows the MESH's devices, not the process
+                # default backend — on a host whose default is a tunneled
+                # TPU, a CPU-rig mesh must still get the interpreter.
+                interp = mesh.devices.flat[0].platform != "tpu"
+                spec = P(("dp", "fsdp"), None, "tp", None)
+                fn = jax.shard_map(
+                    lambda qq, kk, vv: flash_attention(
+                        qq, kk, vv, True, bq, bk, interp),
+                    mesh=mesh,
+                    in_specs=(spec,) * 3,
+                    out_specs=spec,
+                )
+                return fn(q, k, v)
+            # degenerate tiling or sequence-sharded mesh: dense fallback
         return attention_reference(q, k, v, causal=True)
 
     def _block(self, params: dict, x, mesh: Mesh | None):

@@ -288,3 +288,31 @@ def test_moe_capacity_flop_win_on_ep_mesh():
     # with C = T*cf/E -> expect ~E/cf = 4x fewer total flops (allow slack
     # for routing/scatter overhead)
     assert cap < dense / 2, (dense, cap)
+
+
+def test_flash_attention_under_batch_sharded_mesh():
+    """attention='flash' now runs the Pallas kernels per-shard under a
+    dp x fsdp x tp mesh (batch/head sharding never crosses the attention
+    reduction); must match the unsharded apply AND train with finite
+    grads."""
+    devs = jax.devices("cpu")[:8]
+    mesh = par.make_mesh(devs, dp=2, fsdp=2, tp=2)
+    cfg = _cfg(attention="flash", max_seq=64)
+    model = Transformer(cfg)
+    params = model.init(jax.random.PRNGKey(6))
+    rng = np.random.default_rng(6)
+    toks = jnp.asarray(rng.integers(0, cfg.vocab, (4, 64)), jnp.int32)
+    want = model.apply(params, toks)  # unsharded (single-chip flash path)
+    sharded = model.shard_params(params, mesh)
+    with jax.set_mesh(mesh):
+        got = jax.jit(lambda p, t: model.apply(p, t, mesh))(
+            sharded, par.shard_batch(mesh, toks))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=3e-4)
+    # one sharded train step: loss finite
+    opt = optax.adamw(1e-3)
+    batch = {"tokens": toks, "targets": jnp.roll(toks, -1, axis=1)}
+    with jax.set_mesh(mesh):
+        step = jax.jit(model.make_train_step(opt, mesh))
+        _, _, loss = step(sharded, opt.init(sharded),
+                          par.shard_batch(mesh, batch))
+    assert np.isfinite(float(loss))

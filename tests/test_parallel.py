@@ -172,3 +172,33 @@ def test_ring_flash_gradients(causal):
         np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), atol=5e-4,
             err_msg=f"ring-flash grad d{name} mismatch")
+
+
+def test_ring_flash_long_context_16k():
+    """Long-context smoke: T=16384 over sp=8 (2048 per chip), flash inner.
+    Dense attention would build an 8*16k*16k f32 score tensor (~8 GiB);
+    the ring+flash path holds O(T/n * block) per chip — this test passing
+    on the CPU rig is the memory claim, exactness vs the einsum ring body
+    on a strided sample is the correctness claim."""
+    mesh = par.make_mesh(_cpu_devices(8), sp=8)
+    rng = np.random.default_rng(21)
+    B, T, H, D = 1, 16384, 2, 32
+    q, k, v = (jnp.asarray(rng.standard_normal((B, T, H, D)).astype(np.float32) * 0.3)
+               for _ in range(3))
+    got = par.ring_attention_sharded(mesh, q, k, v, causal=True, flash=True)
+    assert got.shape == (B, T, H, D)
+    assert np.isfinite(np.asarray(got)).all()
+    # exactness on a strided subsample of queries vs the dense reference
+    # computed only for those rows (full dense would be the 8 GiB tensor
+    # this path exists to avoid)
+    idx = np.arange(63, T, 1024)
+    qs = q[:, idx]
+    scale = 1.0 / np.sqrt(D)
+    s = jnp.einsum("bqhd,bkhd->bhqk", qs * scale, k)
+    kpos = jnp.arange(T)[None, :]
+    mask = kpos <= jnp.asarray(idx)[:, None]
+    s = jnp.where(mask[None, None], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    want = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    np.testing.assert_allclose(
+        np.asarray(got[:, idx]), np.asarray(want), atol=5e-4)
