@@ -199,7 +199,11 @@ class Transformer:
             bk = auto_block(q.shape[1], 512)
             if bq is not None and mesh is None:
                 return flash_attention(q, k, v, True, bq, bk)
-            if bq is not None and mesh.shape.get(c.sp_axis, 1) <= 1:
+            data = mesh.shape.get("dp", 1) * mesh.shape.get("fsdp", 1)
+            even = (q.shape[0] % data == 0
+                    and c.n_heads % mesh.shape.get("tp", 1) == 0)
+            if (bq is not None and even
+                    and mesh.shape.get(c.sp_axis, 1) <= 1):
                 # batch-sharded mesh (dp/fsdp; heads optionally over tp):
                 # causal self-attention is independent per (batch, head),
                 # so each shard runs the SAME Pallas kernel on its local
@@ -220,7 +224,9 @@ class Transformer:
                     out_specs=spec,
                 )
                 return fn(q, k, v)
-            # degenerate tiling or sequence-sharded mesh: dense fallback
+            # degenerate tiling, uneven batch/head sharding, or a
+            # sequence-sharded mesh: the GSPMD dense path handles all of
+            # them (it tolerates uneven sharding via padding)
         return attention_reference(q, k, v, causal=True)
 
     def _block(self, params: dict, x, mesh: Mesh | None):

@@ -48,12 +48,15 @@ class MarkerCounter:
         self._completion_thread: threading.Thread | None = None
         self._closed = False
         self._native = _load_native()
-        if self._native is not None:
-            self._nid = self._native.ck_createMarkerCounter()
-        else:
-            self._nid = None
-            self._added = 0
-            self._reached = 0
+        # python-side counters always exist: they are the fallback when no
+        # native library is loaded AND the final snapshot after close()
+        # releases the native counter (queries must keep working)
+        self._added = 0
+        self._reached = 0
+        self._nid = (
+            self._native.ck_createMarkerCounter()
+            if self._native is not None else None
+        )
 
     def close(self) -> None:
         """Stop the completion thread and release the native counter.
@@ -66,9 +69,17 @@ class MarkerCounter:
             self._completions.put(None)
             t.join(timeout=5.0)
             self._completion_thread = None
-        if self._nid is not None and self._native is not None:
-            self._native.ck_deleteMarkerCounter(self._nid)
-            self._nid = None
+        # every native access (here and in the count paths) happens under
+        # the lock: a reader racing this delete would otherwise pass a
+        # freed counter id into the C library (use-after-free)
+        with self._lock:
+            if self._nid is not None and self._native is not None:
+                # snapshot final counts so added/reached/remaining() keep
+                # answering after the native counter is gone
+                self._added = int(self._native.ck_markersAdded(self._nid))
+                self._reached = int(self._native.ck_markersReached(self._nid))
+                self._native.ck_deleteMarkerCounter(self._nid)
+                self._nid = None
 
     def __del__(self):
         try:
@@ -78,22 +89,21 @@ class MarkerCounter:
 
     # -- counting ------------------------------------------------------------
     def add(self, n: int = 1) -> None:
-        if self._nid is not None:
-            for _ in range(n):
-                self._native.ck_addMarker(self._nid)
-        else:
-            with self._lock:
+        with self._lock:
+            if self._nid is not None:
+                for _ in range(n):
+                    self._native.ck_addMarker(self._nid)
+            else:
                 self._added += n
 
     def reach(self, n: int = 1) -> None:
         now = time.perf_counter()
-        if self._nid is not None:
-            for _ in range(n):
-                self._native.ck_markerReached(self._nid)
-        else:
-            with self._lock:
-                self._reached += n
         with self._lock:
+            if self._nid is not None:
+                for _ in range(n):
+                    self._native.ck_markerReached(self._nid)
+            else:
+                self._reached += n
             # (time, count) samples: batched retirement observations carry
             # their op count, so reach_speed() stays ops/second — n bunched
             # reach() calls would otherwise compress the window span and
@@ -167,23 +177,23 @@ class MarkerCounter:
     # -- queries -------------------------------------------------------------
     @property
     def added(self) -> int:
-        if self._nid is not None:
-            return int(self._native.ck_markersAdded(self._nid))
         with self._lock:
+            if self._nid is not None:
+                return int(self._native.ck_markersAdded(self._nid))
             return self._added
 
     @property
     def reached(self) -> int:
-        if self._nid is not None:
-            return int(self._native.ck_markersReached(self._nid))
         with self._lock:
+            if self._nid is not None:
+                return int(self._native.ck_markersReached(self._nid))
             return self._reached
 
     def remaining(self) -> int:
         """In-flight depth (reference: countMarkersRemaining)."""
-        if self._nid is not None:
-            return int(self._native.ck_markersRemaining(self._nid))
         with self._lock:
+            if self._nid is not None:
+                return int(self._native.ck_markersRemaining(self._nid))
             return self._added - self._reached
 
     def drain(self, timeout: float = 30.0) -> None:
@@ -204,11 +214,9 @@ class MarkerCounter:
             return ops / span if span > 0 else 0.0
 
     def reset(self) -> None:
-        if self._nid is not None:
-            self._native.ck_resetMarkerCounter(self._nid)
-        else:
-            with self._lock:
-                self._added = 0
-                self._reached = 0
         with self._lock:
+            if self._nid is not None:
+                self._native.ck_resetMarkerCounter(self._nid)
+            self._added = 0
+            self._reached = 0
             self._times.clear()

@@ -119,7 +119,7 @@ def _gen_kernel(seed: int) -> str:
     )
 
 
-@pytest.mark.parametrize("seed", range(20))
+@pytest.mark.parametrize("seed", range(32))
 def test_lowerings_agree(seed):
     src = _gen_kernel(seed)
     kdef = lang.parse_kernels(src)[0]

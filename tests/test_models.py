@@ -316,3 +316,25 @@ def test_flash_attention_under_batch_sharded_mesh():
         _, _, loss = step(sharded, opt.init(sharded),
                           par.shard_batch(mesh, batch))
     assert np.isfinite(float(loss))
+
+
+def test_flash_mesh_uneven_heads_falls_back_to_dense():
+    """attention='flash' with n_heads not divisible by tp must take the
+    GSPMD dense path (which tolerates uneven sharding) instead of a
+    shard_map divisibility error.  (An uneven BATCH is rejected upstream
+    by shard_batch's explicit sharding — not a flash-path concern.)"""
+    devs = jax.devices("cpu")[:4]
+    mesh = par.make_mesh(devs, dp=2, tp=2)
+    cfg = _cfg(attention="flash", max_seq=64, d_model=48, n_heads=3)
+    model = Transformer(cfg)
+    params = model.init(jax.random.PRNGKey(8))
+    rng = np.random.default_rng(8)
+    toks = jnp.asarray(rng.integers(0, cfg.vocab, (4, 64)), jnp.int32)
+    want = Transformer(
+        _cfg(max_seq=64, d_model=48, n_heads=3)
+    ).apply(params, toks)  # dense, unsharded
+    sharded = model.shard_params(params, mesh)
+    with jax.set_mesh(mesh):
+        got = jax.jit(lambda p, t: model.apply(p, t, mesh))(
+            sharded, par.shard_batch(mesh, toks))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=3e-4)

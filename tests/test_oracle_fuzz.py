@@ -173,7 +173,7 @@ def test_oracle_divergent_while_with_builtins():
     }, {})
 
 
-@pytest.mark.parametrize("seed", range(8))
+@pytest.mark.parametrize("seed", range(12))
 def test_oracle_random_gather_kernels(seed):
     """Randomized gather/branch kernels vs the oracle."""
     rng = np.random.default_rng(seed)
