@@ -199,11 +199,12 @@ class Transformer:
             bk = auto_block(q.shape[1], 512)
             if bq is not None and mesh is None:
                 return flash_attention(q, k, v, True, bq, bk)
-            data = mesh.shape.get("dp", 1) * mesh.shape.get("fsdp", 1)
-            even = (q.shape[0] % data == 0
-                    and c.n_heads % mesh.shape.get("tp", 1) == 0)
-            if (bq is not None and even
-                    and mesh.shape.get(c.sp_axis, 1) <= 1):
+            if bq is not None and mesh is not None and (
+                q.shape[0] % (mesh.shape.get("dp", 1)
+                              * mesh.shape.get("fsdp", 1)) == 0
+                and c.n_heads % mesh.shape.get("tp", 1) == 0
+                and mesh.shape.get(c.sp_axis, 1) <= 1
+            ):
                 # batch-sharded mesh (dp/fsdp; heads optionally over tp):
                 # causal self-attention is independent per (batch, head),
                 # so each shard runs the SAME Pallas kernel on its local
