@@ -514,7 +514,11 @@ def main() -> None:
             "DMA events, so transfer overlap uses the RTT-subtracted host "
             "windows in overlap_detail_ms, reported raw, never clipped); "
             "mandelbrot is VPU-bound (not MXU); hbm_utilization is "
-            "cross-dispatch streamed and must be <= 1.0 to be physical"
+            "cross-dispatch streamed and must be <= 1.0 to be physical. "
+            "duplex_ceiling and the overlap sections run minutes apart on a "
+            "link whose bandwidth drifts — when they disagree (raw overlap "
+            "above a near-zero ceiling), both are weather, and the balanced "
+            "regime + device timeline are the durable evidence"
         ),
     }
     print(json.dumps(result))
