@@ -354,6 +354,8 @@ def _halo_window(arr, off, chunk: int, ph: int, halo_h: int):
     L = chunk + 2 * ph
     rows_total = chunk // LANES
     if n < L:
+        # covers whole-buffer launches too (n == chunk < L): the slice of
+        # the length-L padded buffer clamps to offset 0 = the whole pad
         w = lax.dynamic_slice(jnp.pad(arr, (ph, ph), mode="edge"), (off,), (L,))
         return w.reshape(rows_total + 2 * halo_h, LANES)
     start = off - ph                      # may be < 0 or > n - L
