@@ -643,6 +643,20 @@ def _scatter_lanes(stack, ix, vals):
     return stack.at[(ix,) + tuple(lanes)].set(vals)
 
 
+def _loaded(value, ctype: str) -> KVal:
+    """Wrap a loaded buffer value as its DECLARED ctype: when the caller's
+    array dtype differs (e.g. f16 storage behind a float-declared param),
+    the load converts so every in-kernel computation runs in the declared
+    type — the store's cast back to storage dtype is the symmetric
+    inverse.  Without this, loop carries seeded from a load keep the
+    storage dtype while arithmetic promotes, and lax.while raises a carry
+    dtype mismatch at trace time."""
+    dt = ctype_to_dtype(ctype)
+    if hasattr(value, "dtype") and value.dtype != dt:
+        value = value.astype(dt)
+    return KVal(value, ctype)
+
+
 def _load(ctx: _Ctx, node: Index) -> KVal:
     if node.base in ctx.private:
         return _private_load(ctx, node)
@@ -654,15 +668,16 @@ def _load(ctx: _Ctx, node: Index) -> KVal:
     if idx.ctype not in _INT_TYPES:
         raise KernelLanguageError("array index must be an integer", line=node.line)
     if ctx.pallas:
-        return ctx.pallas_load(node, buf, ctype, idx)  # type: ignore[attr-defined]
+        kv = ctx.pallas_load(node, buf, ctype, idx)  # type: ignore[attr-defined]
+        return _loaded(kv.value, ctype)
     if idx.affine is not None and idx.affine[0] == 1 and isinstance(idx.affine[1], int):
         c = idx.affine[1]
         if c == 0:
             start = jnp.asarray(ctx.offset, jnp.int32)
-            return KVal(lax.dynamic_slice(buf, (start,), (ctx.B,)), ctype)
+            return _loaded(lax.dynamic_slice(buf, (start,), (ctx.B,)), ctype)
         padded, lo = ctx.padded_view(node.base, c)
         start = jnp.asarray(ctx.offset + c + lo, jnp.int32)
-        return KVal(lax.dynamic_slice(padded, (start,), (ctx.B,)), ctype)
+        return _loaded(lax.dynamic_slice(padded, (start,), (ctx.B,)), ctype)
     if ctx.uniform_vars and _expr_uniform(
         node.index, ctx.uniform_vars, frozenset(ctx.private)
     ):
@@ -672,11 +687,11 @@ def _load(ctx: _Ctx, node: Index) -> KVal:
         iv = _num(_as_dtype(idx, "int"))
         sidx = iv if (not hasattr(iv, "ndim") or iv.ndim == 0) else iv.reshape(-1)[0]
         sidx = jnp.clip(jnp.asarray(sidx, jnp.int32), 0, buf.shape[0] - 1)
-        return KVal(lax.dynamic_slice(buf, (sidx,), (1,))[0], ctype)
+        return _loaded(lax.dynamic_slice(buf, (sidx,), (1,))[0], ctype)
     iv = _num(_as_dtype(idx, "int"))
     if not hasattr(iv, "ndim") or iv.ndim == 0:
         iv = jnp.full((ctx.B,), iv, dtype=jnp.int32)
-    return KVal(jnp.take(buf, iv, mode="clip"), ctype)
+    return _loaded(jnp.take(buf, iv, mode="clip"), ctype)
 
 
 def _store(ctx: _Ctx, node: Index, val: KVal) -> None:
@@ -690,6 +705,13 @@ def _store(ctx: _Ctx, node: Index, val: KVal) -> None:
     v = _num(_as_dtype(val, ctype))
     if not hasattr(v, "ndim") or v.ndim == 0:
         v = ctx.broadcast_scalar(v, ctype_to_dtype(ctype))
+    if hasattr(buf, "dtype") and v.dtype != buf.dtype:
+        # a store converts to the buffer's STORAGE dtype (a caller may
+        # pass e.g. f16 arrays to a float-declared kernel — compute runs
+        # in the declared ctype, storage keeps the array's dtype); the
+        # gather path's .at[].set already casts, the slice paths below
+        # would crash on the mismatch instead
+        v = v.astype(buf.dtype)
     idx = _eval(ctx, node.index)
     if ctx.pallas:
         ctx.pallas_store(node, buf, ctype, idx, v)  # type: ignore[attr-defined]

@@ -202,6 +202,8 @@ class _PallasCtx(_Ctx):
             )
         if self.record is not None:
             self.record.stored.add(node.base)
+        # (dtype casting happens in codegen._store before this is called:
+        # loads convert storage->declared ctype, stores convert back)
         m = self.active_mask()
         if m is not None:
             v = jnp.where(m, v, buf)
@@ -252,6 +254,36 @@ def _probe(kernel: lang.KernelDef, rows: int, local_size: int, global_size: int,
             "tile-parallel execution would read stale neighbors"
         )
     return stored, acc
+
+
+def _mentions_half(kernel: lang.KernelDef) -> bool:
+    """True if any declared ctype anywhere in the kernel (params, locals,
+    casts, helpers) is 'half' — Mosaic rejects float16 tiles on this chip
+    at compile time, PAST the registry's build-time fallback window, so
+    half-typed kernels must be vetoed here even when no caller ARRAY is
+    f16 (a half local or cast creates f16 tiles internally)."""
+    seen: set[int] = set()
+
+    def walk(node) -> bool:
+        if node is None or id(node) in seen:
+            return False
+        if isinstance(node, (str, int, float, bool)):
+            return False
+        seen.add(id(node))
+        if isinstance(node, (list, tuple)):
+            return any(walk(x) for x in node)
+        if isinstance(node, dict):
+            return any(walk(x) for x in node.values())
+        ct = getattr(node, "ctype", None)
+        if isinstance(ct, str) and ct == "half":
+            return True
+        if hasattr(node, "__dict__"):
+            return any(walk(v) for v in vars(node).values())
+        return False
+
+    return walk(kernel.params) or walk(kernel.body) or walk(
+        getattr(kernel, "helpers", None)
+    )
 
 
 def _routing_veto(acc: _Accesses) -> None:
@@ -393,6 +425,10 @@ def build_kernel_fn_pallas(
 
     if chunk % LANES != 0:
         raise PallasUnsupported(f"chunk {chunk} not a multiple of {LANES}")
+    if not interpret and _mentions_half(kernel):
+        raise PallasUnsupported(
+            "kernel declares 'half' types (Mosaic rejects f16 tiles)"
+        )
     rows_total = chunk // LANES
     rows = min(block_rows, rows_total)
     while rows_total % rows != 0:
@@ -456,9 +492,14 @@ def build_kernel_fn_pallas(
             )
         # AGGREGATE budget: several uniform-read buffers share one SMEM,
         # so their sizes sum (3 x 480KB would pass a per-buffer check and
-        # then fail Mosaic SMEM allocation at launch)
-        if sum(arrays[name_ix[n]].size * arrays[name_ix[n]].dtype.itemsize
-               for n in smem_names) > SMEM_UNIFORM_LIMIT:
+        # then fail Mosaic SMEM allocation at launch).  f16 arrays also
+        # delegate: Mosaic rejects float16 tiles on this chip at compile
+        # time — PAST the registry's build-time PallasUnsupported
+        # fallback — so the dtype check must live here at trace time
+        # (probed on-device, r4; bf16/f32/ints all compile fine).
+        if (any(arrays[i].dtype == jnp.float16 for i in range(len(arrays)))
+                or sum(arrays[name_ix[n]].size * arrays[name_ix[n]].dtype.itemsize
+                       for n in smem_names) > SMEM_UNIFORM_LIMIT):
             return xla_fn()(offset, arrays, values)
         off = jnp.asarray(offset, jnp.int32)
         # window [offset, offset+chunk) of every elementwise/stored param
@@ -500,8 +541,10 @@ def build_kernel_fn_pallas(
             ),
             out_specs=[tile_spec] * len(stored),
             out_shape=[
+                # the ACTUAL array dtype, not the declared ctype's: storage
+                # keeps the caller's dtype when they differ (stores cast)
                 jax.ShapeDtypeStruct(
-                    (rows_total, LANES), ctype_to_dtype(info.array_ctypes[n])
+                    (rows_total, LANES), arrays[name_ix[n]].dtype
                 )
                 for n in stored
             ],

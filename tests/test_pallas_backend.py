@@ -310,3 +310,73 @@ def test_multi_tile_grid_halo_and_smem():
         np.testing.assert_allclose(
             np.asarray(got_x[2]), np.asarray(got_p[2]), rtol=1e-5, atol=1e-5,
             err_msg=f"grid>1 divergence at offset {o}")
+
+
+def test_f16_arrays_delegate_to_xla_inside_fn():
+    """float16 tiles fail the Mosaic compile on the real chip AFTER the
+    registry's build-time fallback window, so the launch fn itself must
+    delegate f16 arrays to the XLA lowering at trace time (probed
+    on-device, r4) — including kernels whose LOOP CARRIES are seeded from
+    the mismatched-dtype load (loads cast to the declared ctype; stores
+    cast back to the storage dtype)."""
+    n = 512
+    x = np.linspace(-2, 2, n).astype(np.float16)
+    y = np.ones(n, np.float16)
+    out_x, out_p = _both(SAXPY, (x, y), values=(3.0,))
+    np.testing.assert_allclose(np.asarray(out_x[1]), np.asarray(out_p[1]),
+                               rtol=1e-2, atol=1e-2)
+    # loop carry seeded from the f16 load: float-declared local must run
+    # the while in f32 (declared), store back f16
+    LOOPY = """
+    __kernel void lp(__global float* x, __global float* o, float a) {
+        int i = get_global_id(0);
+        float t = x[i];
+        while (t < a) {
+            t = t + a * 0.25f;
+        }
+        o[i] = t;
+    }"""
+    x = (np.linspace(-2, 2, n)).astype(np.float16)
+    o = np.zeros(n, np.float16)
+    out_x, out_p = _both(LOOPY, (x, o), values=(1.0,))
+    np.testing.assert_allclose(np.asarray(out_x[1]), np.asarray(out_p[1]),
+                               rtol=1e-2, atol=1e-2)
+
+
+def test_half_declared_kernel_vetoed_for_mosaic():
+    """A kernel that DECLARES half (param/local/cast) creates f16 tiles
+    internally regardless of the caller's array dtypes — vetoed at build
+    time for compiled Mosaic, allowed in interpret mode."""
+    HALFY = """
+    __kernel void h(__global float* x, __global float* o) {
+        int i = get_global_id(0);
+        half t = (half)(x[i]);
+        o[i] = (float)(t) * 2.0f;
+    }"""
+    with pytest.raises(PallasUnsupported):
+        build_kernel_fn_pallas(_kdef(HALFY), 256, 64, 256, interpret=False,
+                               force=True)
+    fn, _ = build_kernel_fn_pallas(_kdef(HALFY), 256, 64, 256,
+                                   interpret=True, force=True)
+    assert fn is not None
+
+
+def test_bf16_arrays_through_real_pallas_path():
+    """bfloat16 arrays against a float-declared kernel exercise the
+    actual-dtype out_shape + load/store casts on the PALLAS path (bf16 is
+    not delegated — Mosaic handles it)."""
+    import jax.numpy as jnp
+
+    n = 512
+    x = jnp.asarray(np.linspace(-2, 2, n), jnp.bfloat16)
+    y = jnp.ones(n, jnp.bfloat16)
+    kdef = _kdef(SAXPY)
+    xla_fn, _ = codegen.build_kernel_fn(kdef, n, 64, n)
+    pl_fn, _ = build_kernel_fn_pallas(kdef, n, 64, n, interpret=True,
+                                      force=True)
+    gx = xla_fn(0, (x, y), (3.0,))
+    gp = pl_fn(0, (x, y), (3.0,))
+    assert gp[1].dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(gx[1], dtype=np.float32), np.asarray(gp[1], dtype=np.float32),
+        rtol=2e-2, atol=2e-2)
