@@ -140,3 +140,33 @@ def test_lowerings_agree(seed):
         got_p, got_x, rtol=1e-5, atol=1e-5,
         err_msg=f"lowering divergence for kernel:\n{src}",
     )
+
+
+@pytest.mark.parametrize("seed", range(5))
+def test_lowerings_agree_mixed_dtypes(seed):
+    """The dtype-boundary contract (loads cast storage -> declared ctype,
+    stores cast back) must hold for ANY caller array dtype against the
+    float-declared generator kernels — output dtypes preserved, values
+    within low-precision tolerance, both lowerings in agreement."""
+    import jax.numpy as jnp2
+
+    DTYPES = [jnp2.float32, jnp2.bfloat16, jnp2.float16, jnp2.int32]
+    src = _gen_kernel(seed)
+    kdef = lang.parse_kernels(src)[0]
+    rng = np.random.default_rng(7000 + seed)
+    dts = [DTYPES[rng.integers(0, len(DTYPES))] for _ in range(3)]
+    arrs = tuple(
+        jnp2.asarray((rng.standard_normal(N) * 2).astype(np.float32)).astype(dt)
+        for dt in dts
+    )
+    xla_fn, _ = codegen.build_kernel_fn(kdef, N, 64, N)
+    pl_fn, _ = build_kernel_fn_pallas(kdef, N, 64, N, interpret=True,
+                                     force=True)
+    gx = xla_fn(0, arrs, ())
+    gp = pl_fn(0, arrs, ())
+    for i, (a, b) in enumerate(zip(gx, gp)):
+        assert a.dtype == b.dtype == arrs[i].dtype, (i, a.dtype, b.dtype)
+        np.testing.assert_allclose(
+            np.asarray(a, dtype=np.float32), np.asarray(b, dtype=np.float32),
+            rtol=3e-2, atol=3e-2,
+            err_msg=f"arr{i} dtype={a.dtype} kernel:\n{src}")
