@@ -142,6 +142,21 @@ def flash_train_faceoff(B=1, T=4096, H=8, D=64, reps=10):
     # the section() guard turns this into a reported error rather than a
     # silent wrong-gradient bench
     assert rel < 5e-4, f"flash bwd grads diverged from dense: rel={rel:.2e}"
+    # second shape: T=8192, where dense's [T,T] cost has quadrupled and
+    # the flash advantage is structural rather than marginal
+    T2 = T * 2
+    rng2 = np.random.default_rng(1)
+    mk2 = lambda: jnp.asarray(
+        rng2.standard_normal((B, T2, H, D)).astype(np.float32) * 0.3
+    )
+    q, k, v = mk2(), mk2(), mk2()
+    reps = max(4, reps // 2)
+    dt_hi2, _ = bench(
+        lambda q, k, v: flash_attention(q, k, v, True, 256, 512).sum()
+    )
+    dt_d2, _ = bench(
+        lambda q, k, v: attention_reference(q, k, v, causal=True).sum()
+    )
     return {
         "flash_highest_ms": round(dt_hi * 1e3, 2),
         "flash_default_ms": round(dt_def * 1e3, 2),
@@ -150,6 +165,9 @@ def flash_train_faceoff(B=1, T=4096, H=8, D=64, reps=10):
         "speedup_default": round(dt_d / dt_def, 2),
         "grad_max_rel_err_highest": float(f"{rel:.2e}"),
         "shape": f"B{B} T{T} H{H} D{D} f32 causal blocks 256/512",
+        "T8192_flash_highest_ms": round(dt_hi2 * 1e3, 2),
+        "T8192_dense_ms": round(dt_d2 * 1e3, 2),
+        "T8192_speedup_highest": round(dt_d2 / dt_hi2, 2),
         "note": (
             "highest = true-f32 MXU (grads match dense to ~5e-5); "
             "default = bf16 MXU passes, the standard flash trade "
