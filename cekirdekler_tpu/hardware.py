@@ -313,9 +313,27 @@ class Platforms(Sequence[Platform]):
     @staticmethod
     def all() -> "Platforms":
         """Enumerate every usable backend (reference: ClPlatforms.all(),
-        ClObjectApi.cs:204-216)."""
+        ClObjectApi.cs:204-216).
+
+        When ``JAX_PLATFORMS`` pins the process to specific backends, only
+        those are probed: probing an excluded platform can still touch its
+        plugin's client init (and a skewed accelerator plugin raises from
+        *inside* a probe that looks guarded — the r4 artifact lost its
+        compute()-path proof exactly this way)."""
+        import os
+
+        candidates: tuple[str, ...] = ("tpu", "axon", "cuda", "rocm", "cpu")
+        pinned = os.environ.get("JAX_PLATFORMS", "")
+        if pinned:
+            allowed = {p.strip() for p in pinned.split(",") if p.strip()}
+            if "gpu" in allowed:  # jax's alias for the cuda/rocm plugins
+                allowed |= {"cuda", "rocm"}
+            # a pin naming only platforms outside our candidate list still
+            # means "probe nothing else" — the not-found fallback below
+            # enumerates jax.devices(), which honors the pin
+            candidates = tuple(b for b in candidates if b in allowed)
         found: list[Platform] = []
-        for backend in ("tpu", "axon", "cuda", "rocm", "cpu"):
+        for backend in candidates:
             try:
                 devs = jax.devices(backend)
             except Exception:
