@@ -1,16 +1,22 @@
-"""Cluster tier: multi-node compute over TCP (reference L6,
-SURVEY.md §2.1 #11-16).
+"""Cluster tier: multi-host compute (reference L6, SURVEY.md §2.1 #11-16).
 
-For TPU pods the idiomatic multi-host path is one JAX distributed runtime
-spanning hosts (parallel/ meshes over DCN); this tier reproduces the
-reference's explicit node orchestration — a :class:`ClusterAccelerator`
-driving :class:`CruncherServer` nodes through the :class:`CruncherClient`
-wire protocol — for parity and for heterogeneous/ad-hoc fleets.
+Two tiers share the :class:`IComputeNode` surface:
+
+- **DCN tier (primary)** — :class:`DistributedAccelerator` (dcn.py): the
+  same ``compute()`` spanning the processes of a JAX distributed job,
+  balanced in LCM-step units, results exchanged with XLA collectives over
+  DCN.  This is the TPU-pod idiom (SURVEY.md §7 step 6).
+- **TCP tier (parity fallback)** — :class:`ClusterAccelerator` driving
+  :class:`CruncherServer` nodes through the :class:`CruncherClient` wire
+  protocol: reproduces the reference's explicit node orchestration for
+  heterogeneous/ad-hoc fleets and keeps the mid-compute failover + probe
+  capabilities a static jax.distributed job cannot express.
 """
 
 from .accelerator import ClusterAccelerator, IComputeNode
 from .balancer import ClusterLoadBalancer
 from .client import CruncherClient
+from .dcn import DistributedAccelerator
 from .netbuffer import ArrayRecord, Command, Message, recv_message, send_message
 from .server import CruncherServer
 
@@ -21,6 +27,7 @@ __all__ = [
     "Command",
     "CruncherClient",
     "CruncherServer",
+    "DistributedAccelerator",
     "IComputeNode",
     "Message",
     "recv_message",

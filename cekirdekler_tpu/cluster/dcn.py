@@ -1,0 +1,301 @@
+"""Multi-host DCN tier over the JAX distributed runtime.
+
+The primary multi-host path (SURVEY.md §7 step 6, §5.8): the same
+``compute()`` surface as :class:`ClusterAccelerator`, but spanning the N
+*processes* of a JAX distributed job — each host computes its balanced
+share on its process-local chips via a local :class:`NumberCruncher`, and
+written ranges are exchanged with **XLA collectives over DCN** (an
+all-gather jitted across the global device set) instead of the TCP tier's
+hand-framed sockets.  The TCP tier (`accelerator.py`/`server.py`) remains
+the reference-parity fallback for hosts outside a JAX distributed job.
+
+Reference analogue: ``ClusterAccelerator.compute()``
+(ClusterAccelerator.cs:170-355) driving remote ``Cores`` over
+``NetworkBuffer`` marshaling (ClCruncherServerThread.cs:147-250).  Design
+divergences, all TPU-pod idioms:
+
+- **SPMD, not master/worker**: every process runs the same program and the
+  same balancer arithmetic on identically all-gathered timings, so the
+  per-compute-id splits agree everywhere without a control channel — the
+  jax.distributed coordinator replaces SETUP/COMPUTE framing entirely.
+- **Step-quantized shares**: per-process step = local device count ×
+  local_range; the LCM-step :class:`ClusterLoadBalancer` is reused as-is.
+  The remainder share goes to process 0 (the reference's "mainframe").
+- **write_all single-owner rule**: process 0 owns write_all arrays
+  (broadcast_one_to_all), mirroring the TCP tier's rule that remote nodes
+  never return write_all payloads (server.py).
+- **Static membership**: jax.distributed jobs cannot lose or add processes
+  mid-run, so the TCP tier's mid-compute failover has no analogue here —
+  elastic recovery stays a TCP-tier capability.
+
+Testable without a pod: 2 processes × 4 virtual CPU devices each, with
+``gloo`` cross-process collectives (tests/test_dcn.py).
+"""
+
+from __future__ import annotations
+
+import functools as _functools
+import time
+from typing import Sequence
+
+import numpy as np
+
+from ..arrays.clarray import ClArray, ParameterGroup
+from ..core.cruncher import NumberCruncher
+from ..errors import CekirdeklerError, ComputeValidationError
+from ..hardware import Device, Devices
+from .accelerator import IComputeNode
+from .balancer import ClusterLoadBalancer
+
+__all__ = ["initialize", "DistributedAccelerator"]
+
+
+@_functools.lru_cache(maxsize=4)
+def _process_mesh():
+    """1-D mesh with ONE device per process (each process's first local
+    device, in process order) — the cross-host exchange lattice.  Cached:
+    membership of a jax.distributed job is static."""
+    import jax
+    from jax.sharding import Mesh
+
+    first: dict[int, object] = {}
+    for d in jax.devices():  # coordinator-assigned order, same everywhere
+        first.setdefault(d.process_index, d)
+    devs = [first[p] for p in sorted(first)]
+    return Mesh(np.array(devs), ("x",))
+
+
+@_functools.lru_cache(maxsize=4)
+def _replicator(mesh):
+    """One compiled all-gather (replicating identity) per mesh — a fresh
+    ``jax.jit`` per call would re-trace and re-compile on every exchange,
+    a cross-host synchronization point on the hot path."""
+    import jax
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    return jax.jit(lambda a: a, out_shardings=NamedSharding(mesh, P()))
+
+
+def initialize(
+    coordinator_address: str,
+    num_processes: int,
+    process_id: int,
+    *,
+    cpu_collectives: str = "gloo",
+) -> None:
+    """Join the JAX distributed job (idempotent).
+
+    Wraps ``jax.distributed.initialize`` with the CPU-collectives
+    implementation configured first — without it a multi-process CPU
+    backend (the virtual test rig) comes up with single-process visibility
+    and every cross-process collective silently degenerates."""
+    import jax
+
+    if jax.distributed.is_initialized():
+        return  # already joined
+    if cpu_collectives:
+        try:
+            jax.config.update(
+                "jax_cpu_collectives_implementation", cpu_collectives
+            )
+        except Exception:
+            pass  # flag absent on this jax version; TPU pods don't need it
+    jax.distributed.initialize(
+        coordinator_address, num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+class DistributedAccelerator(IComputeNode):
+    """N host processes behaving as ONE device over DCN.
+
+    Construct AFTER :func:`initialize` (or ``jax.distributed.initialize``)
+    in every process of the job, then use exactly like a
+    :class:`NumberCruncher`-backed node: ``setup_nodes(src)`` once,
+    ``compute(...)`` per step.  Every process must make the same calls in
+    the same order (SPMD) — the collectives inside are global.
+
+    ``timing_hook(compute_id, share, wall_ms) -> float`` optionally
+    replaces the measured local wall time fed to the balancer — the same
+    deterministic-bench-injection seam ``benchrig.compute_path_proof``
+    uses, because on shared-core virtual rigs wall time measures scheduler
+    contention, not work.
+    """
+
+    def __init__(self, local_devices: Devices | None = None,
+                 timing_hook=None):
+        import jax
+
+        self.pid = jax.process_index()
+        self.nproc = jax.process_count()
+        if local_devices is None:
+            local_devices = Devices(Device(d) for d in jax.local_devices())
+        if not len(local_devices):
+            raise CekirdeklerError("no process-local devices")
+        self.local_devices = local_devices
+        self.timing_hook = timing_hook
+        self.cruncher: NumberCruncher | None = None
+        self.kernel_source: str | None = None
+        self.proc_device_counts: list[int] = []
+        self.balancers: dict[int, ClusterLoadBalancer] = {}
+        self.ranges: dict[int, list[int]] = {}
+        self.timings: dict[int, list[float]] = {}
+
+    # -- collective helpers --------------------------------------------------
+    @staticmethod
+    def _allgather(value: np.ndarray) -> np.ndarray:
+        """Per-process all-gather → ``[nproc, *value.shape]`` via a jitted
+        XLA all-gather over one device per process (the DCN path).
+
+        Built directly on a process-representative device mesh rather than
+        ``multihost_utils.process_allgather``: the latter reshapes the
+        device list to (nproc, local_count) and so requires every process
+        to hold the SAME number of devices — true on TPU pods, not on
+        ad-hoc CPU fleets or asymmetric test rigs.  Each process's payload
+        rides its first local device, so exactly ``nproc`` rows move over
+        DCN (no zero rows for the other local chips).
+
+        Payloads cross as raw bytes: ``device_put`` canonicalizes
+        int64/float64 to 32-bit when ``jax_enable_x64`` is off (the
+        production default), which would silently wrap/round 64-bit host
+        arrays — the TCP tier ships raw bytes, and the two tiers must
+        agree."""
+        import jax
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        value = np.ascontiguousarray(value)
+        raw = value.view(np.uint8)
+        mesh = _process_mesh()
+        nproc = mesh.devices.size
+        my_dev = jax.local_devices()[0]
+        shard = jax.device_put(raw[None], my_dev)
+        garr = jax.make_array_from_single_device_arrays(
+            (nproc,) + raw.shape, NamedSharding(mesh, P("x")), [shard]
+        )
+        gathered = np.asarray(_replicator(mesh)(garr))
+        return gathered.view(value.dtype).reshape((nproc,) + value.shape)
+
+    @classmethod
+    def _broadcast0(cls, value: np.ndarray) -> np.ndarray:
+        """Process 0's copy, everywhere (write_all single-owner rule)."""
+        return cls._allgather(value)[0]
+
+    def barrier(self, tag: str = "ck_dcn_barrier") -> None:
+        """Cross-process sync point (reference: the TCP tier's synchronous
+        request/reply implies one; here it is explicit)."""
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(tag)
+
+    # -- IComputeNode --------------------------------------------------------
+    def setup_nodes(self, kernel_source: str) -> None:
+        """Compile the kernel locally and agree on the per-process step
+        table (reference: setupNodes, ClusterAccelerator.cs:364-443 —
+        minus the socket handshake the coordinator already did)."""
+        self.kernel_source = kernel_source
+        self.cruncher = NumberCruncher(self.local_devices, kernel_source)
+        counts = self._allgather(
+            np.asarray([len(self.local_devices)], np.int64)
+        )
+        self.proc_device_counts = [int(c) for c in counts.reshape(-1)]
+
+    @property
+    def num_nodes(self) -> int:
+        return self.nproc
+
+    def compute(
+        self,
+        kernel_names: str | Sequence[str],
+        params: Sequence[ClArray],
+        compute_id: int,
+        global_range: int,
+        local_range: int = 256,
+        values=(),
+    ) -> None:
+        if self.cruncher is None:
+            raise CekirdeklerError("setup_nodes() must run before compute()")
+        names = (
+            kernel_names.split()
+            if isinstance(kernel_names, str)
+            else list(kernel_names)
+        )
+        if global_range % local_range != 0:
+            raise ComputeValidationError(
+                f"global_range ({global_range}) must be divisible by "
+                f"local_range ({local_range})"
+            )
+        params = list(params)
+
+        # identical balancer state on every process: inputs are the
+        # all-gathered timings of the previous call and the shared range
+        # table, so the arithmetic below agrees without coordination
+        bal = self.balancers.get(compute_id)
+        if bal is None:
+            steps = [c * local_range for c in self.proc_device_counts]
+            bal = ClusterLoadBalancer(steps)
+            self.balancers[compute_id] = bal
+            shares, remainder = bal.equal_split(global_range)
+        else:
+            prev = self.ranges[compute_id]
+            times = self.timings.get(compute_id, [1.0] * self.nproc)
+            shares, remainder = bal.rebalance(prev, times, global_range)
+        shares = list(shares)
+        shares[0] += remainder  # process 0 is the mainframe
+        refs = np.concatenate([[0], np.cumsum(shares)]).astype(int)
+        self.ranges[compute_id] = shares
+
+        my_share = shares[self.pid]
+        my_off = int(refs[self.pid])
+        t0 = time.perf_counter()
+        if my_share > 0:
+            group = ParameterGroup(params)
+            group.compute(
+                self.cruncher, compute_id, names, my_share, local_range,
+                global_offset=my_off, values=values,
+            )
+        wall_ms = (time.perf_counter() - t0) * 1000.0
+        if self.timing_hook is not None:
+            wall_ms = float(self.timing_hook(compute_id, my_share, wall_ms))
+
+        # result exchange: every process contributes its written range,
+        # padded to the max share so the all-gather is rectangular; the
+        # collective sequence below is identical on every process (it
+        # depends only on the shared share table and array flags)
+        max_elems = int(max(shares))
+        for p in params:
+            if not (p.flags.write and not p.flags.read_only):
+                continue
+            host = p.host()
+            if p.flags.write_all:
+                # single-owner rule (server.py): process 0's copy wins
+                np.copyto(host, self._broadcast0(host))
+                continue
+            epw = p.flags.elements_per_work_item
+            pad = np.zeros(max_elems * epw, host.dtype)
+            if my_share > 0:
+                lo = my_off * epw
+                n = my_share * epw
+                pad[:n] = host[lo:lo + n]
+            gathered = self._allgather(pad)
+            for j in range(self.nproc):
+                if j == self.pid or shares[j] <= 0:
+                    continue
+                lo = int(refs[j]) * epw
+                n = shares[j] * epw
+                host[lo:lo + n] = gathered[j, :n]
+
+        times = self._allgather(np.asarray([wall_ms], np.float64))
+        self.timings[compute_id] = [float(t) for t in times.reshape(-1)]
+
+    def compute_timing(self, compute_id: int) -> list[float]:
+        return list(self.timings.get(compute_id, []))
+
+    def ranges_of(self, compute_id: int) -> list[int]:
+        return list(self.ranges.get(compute_id, []))
+
+    def dispose(self) -> None:
+        if self.cruncher is not None:
+            self.cruncher.dispose()
+            self.cruncher = None

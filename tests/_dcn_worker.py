@@ -1,0 +1,76 @@
+"""Worker process for tests/test_dcn.py: one process of a 2-process JAX
+distributed job (4 virtual CPU devices each).  Run as
+``python tests/_dcn_worker.py <pid> <nproc> <port>`` with a clean CPU env.
+
+Verifies, from inside the job:
+- correct results after 6 balanced multi-process compute() calls,
+- the share table sums to the global range and agrees across processes,
+- the LCM-step balancer moved work away from the (deterministically)
+  slow process.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+SRC = """
+__kernel void saxpy(__global float* x, __global float* y, float a) {
+    int i = get_global_id(0);
+    y[i] = y[i] + a * x[i];
+}
+"""
+
+
+def main(pid: int, nproc: int, port: int) -> None:
+    from cekirdekler_tpu.arrays.clarray import ClArray
+    from cekirdekler_tpu.cluster.dcn import DistributedAccelerator, initialize
+
+    initialize(f"localhost:{port}", nproc, pid)
+    import jax
+
+    assert jax.process_count() == nproc
+    assert jax.local_device_count() == 4
+
+    # deterministic timing injection: process 1 reports 3x the per-item
+    # cost, so the balancer must shift work to process 0 — wall time on a
+    # shared-core rig is contention noise (see DistributedAccelerator doc)
+    hook = lambda cid, share, wall: float(share) * (3.0 if pid == 1 else 1.0)
+    acc = DistributedAccelerator(timing_hook=hook)
+    try:
+        acc.setup_nodes(SRC)
+        n = 4096
+        calls = 6
+        x = ClArray(np.arange(n, dtype=np.float32), partial_read=True,
+                    read_only=True)
+        y = ClArray(np.ones(n, np.float32), partial_read=True)
+        for _ in range(calls):
+            acc.compute(["saxpy"], [x, y], compute_id=1, global_range=n,
+                        local_range=64, values=(2.0,))
+            shares = acc.ranges_of(1)
+            assert sum(shares) == n, shares
+        np.testing.assert_array_equal(
+            np.asarray(y), 1.0 + calls * 2.0 * np.arange(n, dtype=np.float32)
+        )
+        final = acc.ranges_of(1)
+        # share tables must agree across processes (SPMD balancer)
+        agreed = acc._allgather(np.asarray(final, np.int64))
+        assert (agreed == np.asarray(final)[None, :]).all(), agreed
+        assert final[0] > final[1], f"balancer did not move: {final}"
+        timings = acc.compute_timing(1)
+        assert len(timings) == nproc and timings[1] > timings[0], timings
+        # 64-bit payloads must survive the exchange even with x64 disabled
+        # (the parent test clears JAX_ENABLE_X64): the gather moves raw
+        # bytes, so device_put's int64->int32 canonicalization never sees
+        # the data
+        big = acc._allgather(np.asarray([2**40 + pid], np.int64))
+        assert big.dtype == np.int64 and big[1, 0] == 2**40 + 1, big
+        print(f"DCN_OK pid={pid} final={final}", flush=True)
+    finally:
+        acc.dispose()
+
+
+if __name__ == "__main__":
+    main(int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3]))

@@ -1,0 +1,58 @@
+"""DCN multi-host tier (cluster/dcn.py): 2 real processes × 4 virtual CPU
+devices each, joined through a jax.distributed coordinator, computing one
+balanced global range with results exchanged over XLA collectives
+(SURVEY.md §7 step 6; VERDICT r4 next-round #4).
+
+The in-job assertions (correctness, share agreement, balancer movement)
+live in tests/_dcn_worker.py — this file owns process lifecycle only.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _worker_env(n_devices: int) -> dict:
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    # production default: x64 OFF — the worker's 64-bit exchange check
+    # must run against real canonicalization, not the rig's x64 override
+    env.pop("JAX_ENABLE_X64", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={n_devices}"
+    return env
+
+
+def test_two_process_distributed_compute():
+    here = os.path.dirname(os.path.abspath(__file__))
+    worker = os.path.join(here, "_dcn_worker.py")
+    port = _free_port()
+    nproc = 2
+    procs = [
+        subprocess.Popen(
+            [sys.executable, worker, str(pid), str(nproc), str(port)],
+            env=_worker_env(4), cwd=os.path.dirname(here),
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        )
+        for pid in range(nproc)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=240)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait(timeout=10)
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {pid} failed:\n{out[-3000:]}"
+        assert f"DCN_OK pid={pid}" in out, out[-3000:]
