@@ -77,6 +77,20 @@ def _replicator(mesh):
     return jax.jit(lambda a: a, out_shardings=NamedSharding(mesh, P()))
 
 
+@_functools.lru_cache(maxsize=4)
+def _reducer(mesh):
+    """One compiled replicating row-sum per mesh (the broadcast path)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    return jax.jit(
+        lambda a: a.sum(axis=0).astype(jnp.uint8),
+        out_shardings=NamedSharding(mesh, P()),
+    )
+
+
 def initialize(
     coordinator_address: str,
     num_processes: int,
@@ -177,10 +191,29 @@ class DistributedAccelerator(IComputeNode):
         gathered = np.asarray(_replicator(mesh)(garr))
         return gathered.view(value.dtype).reshape((nproc,) + value.shape)
 
-    @classmethod
-    def _broadcast0(cls, value: np.ndarray) -> np.ndarray:
-        """Process 0's copy, everywhere (write_all single-owner rule)."""
-        return cls._allgather(value)[0]
+    @staticmethod
+    def _broadcast0(value: np.ndarray) -> np.ndarray:
+        """Process 0's copy, everywhere (write_all single-owner rule).
+
+        An owner-masked byte psum over the process mesh, NOT an N-row
+        all-gather: non-owners contribute exact zeros, so the replicated
+        row-sum IS the owner's payload, and a reduce+broadcast moves
+        O(M) per link where gathering N full copies moves O(N·M)."""
+        import jax
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        value = np.ascontiguousarray(value)
+        raw = value.view(np.uint8)
+        mesh = _process_mesh()
+        nproc = mesh.devices.size
+        mine = raw if jax.process_index() == 0 else np.zeros_like(raw)
+        shard = jax.device_put(mine[None], jax.local_devices()[0])
+        garr = jax.make_array_from_single_device_arrays(
+            (nproc,) + raw.shape, NamedSharding(mesh, P("x")), [shard]
+        )
+        out = np.asarray(_reducer(mesh)(garr))
+        return out.view(value.dtype).reshape(value.shape)
 
     def barrier(self, tag: str = "ck_dcn_barrier") -> None:
         """Cross-process sync point (reference: the TCP tier's synchronous

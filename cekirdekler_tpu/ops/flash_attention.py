@@ -168,20 +168,28 @@ def _blocks_for(Tq: int, Tk: int, block_q: int, block_k: int):
     """Effective (bq, bk): the largest divisors of the sequence lengths
     not exceeding the requested blocks (gcd) — so default-argument calls
     degrade gracefully for any T a smaller block would have handled
-    (e.g. T=640 with the 256 default -> 128).  Degradation is bounded at
-    a quarter of the smaller requested block (floor 8): an awkward length
-    like T=4104 would gcd down to 8-wide tiles, a regime far slower than
-    the dense attention this replaces — raising loudly there beats
-    running silently at 100x cost."""
+    (e.g. T=640 with the 256 default -> 128).
+
+    Below a quarter of the smaller requested block, the gcd path falls
+    back per axis to :func:`auto_block` (largest power-of-two divisor of
+    T), floored at 32: short sequences like T=32 or T=96 that the old
+    128/128 defaults accepted keep working after the 256/512 retune
+    (r4 advisor note), while genuinely awkward lengths (T=4104 → 8-wide
+    tiles, ~100x slower than the dense einsum this replaces) still raise
+    loudly rather than run silently degenerate."""
     bq = math.gcd(Tq, block_q)
     bk = math.gcd(Tk, block_k)
     floor = max(8, min(block_q, block_k) // 4)
     if bq < floor or bk < floor:
-        raise ValueError(
-            f"sequence lengths (Tq={Tq}, Tk={Tk}) admit only degenerate "
-            f"tiles ({bq}, {bk}) for requested blocks ({block_q}, "
-            f"{block_k}); use auto_block() or pad the sequence"
-        )
+        bq2 = auto_block(Tq, target=block_q, floor=32)
+        bk2 = auto_block(Tk, target=block_k, floor=32)
+        if bq2 is None or bk2 is None:
+            raise ValueError(
+                f"sequence lengths (Tq={Tq}, Tk={Tk}) admit only degenerate "
+                f"tiles ({bq}, {bk}) for requested blocks ({block_q}, "
+                f"{block_k}); use auto_block() or pad the sequence"
+            )
+        bq, bk = bq2, bk2
     return bq, bk
 
 
@@ -442,13 +450,16 @@ def _fa_bwd_dkv_kernel(*refs, scale, block_q, block_k, n_qb, causal,
 def _flash_backward(q, k, v, out, lse3, do, causal, block_q, block_k,
                     interpret, precision):
     """Tiled flash backward: dq in one pallas_call (k minor), dk/dv in a
-    second (q minor).  ``lse3`` arrives lane-broadcast [B*H, Tq, 128]
-    straight from the forward residual (no slice/re-broadcast round
-    trip); delta = rowsum(dO ∘ O) is a cheap XLA reduction."""
+    second (q minor).  ``lse3`` arrives in compact [B*H, Tq, 1] layout
+    (the residual held across the fwd→bwd interval must be O(T), not
+    O(128·T) — r4 advisor note) and is re-broadcast to the 128-lane tile
+    layout here, at backward time; delta = rowsum(dO ∘ O) is a cheap XLA
+    reduction."""
     from jax.experimental.pallas import tpu as pltpu
 
     B, Tq, H, D = q.shape
     Tk = k.shape[1]
+    lse3 = jnp.broadcast_to(lse3[..., :1], (B * H, Tq, 128))
     bq, bk = _blocks_for(Tq, Tk, block_q, block_k)
     scale = 1.0 / math.sqrt(D)
     q3 = q.transpose(0, 2, 1, 3).reshape(B * H, Tq, D)
@@ -545,7 +556,10 @@ def _fa_fwd(q, k, v, causal, block_q, block_k, interpret, precision):
     out, lse3 = _flash_forward(
         q, k, v, causal, block_q, block_k, interpret, prec, with_lse=True
     )
-    return out, (q, k, v, out, lse3)
+    # keep only lane 0 of the lane-broadcast kernel output: the residual
+    # saved across the whole forward→backward interval is [B*H, Tq, 1]
+    # f32 (true O(T)), not the 128x lane-broadcast tile layout
+    return out, (q, k, v, out, lse3[..., :1])
 
 
 def _fa_bwd(causal, block_q, block_k, interpret, precision, res, do):

@@ -67,6 +67,11 @@ def main(pid: int, nproc: int, port: int) -> None:
         # the data
         big = acc._allgather(np.asarray([2**40 + pid], np.int64))
         assert big.dtype == np.int64 and big[1, 0] == 2**40 + 1, big
+        # write_all single-owner rule: everyone ends with process 0's copy
+        # (owner-masked psum path), 64-bit payload again deliberate
+        mine = np.arange(5, dtype=np.float64) + (100.0 if pid == 0 else -7.0)
+        got = acc._broadcast0(mine)
+        assert got.dtype == np.float64 and got[0] == 100.0, got
         print(f"DCN_OK pid={pid} final={final}", flush=True)
     finally:
         acc.dispose()

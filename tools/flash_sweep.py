@@ -1,0 +1,91 @@
+"""Flash-attention block/phase sweep on the real chip (VERDICT r4 #2).
+
+Separates forward-only and fwd+bwd cost per (T, block_q, block_k) so the
+T=8192 regression can be attributed (fwd kernel? dq kernel? dkv kernel?
+block config?) instead of guessed at.
+
+Methodology (see docs + round-4 notes): the tunnel's dispatch latency is
+~RTT (today's weather: can exceed 100 ms), so a python loop of jitted
+calls measures the link, not the chip — every rep anomaly (bwd "faster"
+than fwd) is dispatch noise.  Here the dependent chain runs INSIDE one
+jitted ``lax.fori_loop`` (each step perturbs the inputs by the previous
+step's output so nothing hoists or elides), one dispatch, one
+materialization, measured RTT subtracted once.
+
+Usage: python tools/flash_sweep.py [T ...]
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def bench_loop(step, args, reps=8, trials=3, rtt=0.0):
+    """The shared dependent-chain harness — one implementation, one place
+    for the elision traps (see its docstring)."""
+    from cekirdekler_tpu.workloads import fori_chain_bench
+
+    return fori_chain_bench(step, args, reps, trials=trials, rtt=rtt)
+
+
+def main(Ts=(4096, 8192), B=1, H=8, D=64):
+    from cekirdekler_tpu.ops.flash_attention import flash_attention
+    from cekirdekler_tpu.parallel.attention import attention_reference
+    from cekirdekler_tpu.workloads import measure_rtt
+
+    rtt = measure_rtt()
+    print(f"rtt_ms={rtt*1e3:.1f}  B={B} H={H} D={D}")
+    rng = np.random.default_rng(0)
+    for T in Ts:
+        mk = lambda: jnp.asarray(
+            rng.standard_normal((B, T, H, D)).astype(np.float32) * 0.3)
+        q, k, v = mk(), mk(), mk()
+        # causal fwd+bwd FLOPs: fwd 4*T^2*D per (b,h) + bwd 12*T^2*D,
+        # halved by causality
+        flops = 0.5 * 16 * B * H * T * T * D
+        flops_fwd = 0.5 * 4 * B * H * T * T * D
+
+        t = bench_loop(
+            lambda q, k, v: attention_reference(q, k, v, causal=True),
+            (q, k, v), rtt=rtt)
+        print(f"T={T} dense fwd: {t*1e3:8.2f} ms  "
+              f"{flops_fwd/t/1e12:6.2f} Tflop/s")
+        t = bench_loop(
+            jax.grad(lambda q, k, v: attention_reference(
+                q, k, v, causal=True).sum(), argnums=(0, 1, 2)),
+            (q, k, v), rtt=rtt)
+        print(f"T={T} dense fwd+bwd: {t*1e3:8.2f} ms  "
+              f"{flops/t/1e12:6.2f} Tflop/s")
+
+        for (bq, bk) in ((256, 512), (512, 512), (512, 1024), (256, 1024),
+                         (1024, 512), (128, 512)):
+            for prec in ("highest", "default"):
+                fwd = lambda q, k, v, bq=bq, bk=bk, p=prec: flash_attention(
+                    q, k, v, True, bq, bk, None, p)
+                g = jax.grad(
+                    lambda q, k, v, bq=bq, bk=bk, p=prec: flash_attention(
+                        q, k, v, True, bq, bk, None, p).sum(),
+                    argnums=(0, 1, 2))
+                try:
+                    tf = bench_loop(fwd, (q, k, v), rtt=rtt)
+                    tg = bench_loop(g, (q, k, v), rtt=rtt)
+                except Exception as e:
+                    print(f"T={T} flash {bq}/{bk} {prec}: FAIL "
+                          f"{type(e).__name__}: {e}"[:120])
+                    continue
+                print(f"T={T} flash {bq}/{bk} {prec:7s}: "
+                      f"fwd {tf*1e3:8.2f} ms ({flops_fwd/tf/1e12:5.2f}) "
+                      f"fwd+bwd {tg*1e3:8.2f} ms  "
+                      f"{flops/tg/1e12:6.2f} Tflop/s")
+
+
+if __name__ == "__main__":
+    Ts = tuple(int(a) for a in sys.argv[1:]) or (4096, 8192)
+    main(Ts)
