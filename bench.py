@@ -95,7 +95,7 @@ V5E_PEAK_BF16_TFLOPS = 197.0   # v5e MXU, bf16 (public spec)
 V5E_PEAK_F32_TFLOPS = V5E_PEAK_BF16_TFLOPS / 6.0
 
 
-def flash_train_faceoff(B=2, H=8, D=64):
+def flash_train_faceoff(B=2, H=8, D=64, block_q=512, block_k=512):
     """Flash attention fwd+bwd (tiled Pallas backward) vs dense XLA
     attention, per training step, at T=4096 and T=8192 — with achieved
     Tflop/s and MFU per row (VERDICT r4 #2).
@@ -142,7 +142,7 @@ def flash_train_faceoff(B=2, H=8, D=64):
         return best
 
     out: dict = {
-        "shape": f"B{B} H{H} D{D} f32 causal, flash blocks 512/1024",
+        "shape": f"B{B} H{H} D{D} f32 causal, flash blocks {block_q}/{block_k}",
         "rtt_ms": round(rtt * 1e3, 1),
         "note": (
             "highest = true-f32 MXU passes (grads match dense to ~5e-5), "
@@ -164,20 +164,30 @@ def flash_train_faceoff(B=2, H=8, D=64):
         flops = 0.5 * 16 * B * H * T * T * D  # causal fwd+bwd
 
         loss_hi = lambda q, k, v: flash_attention(
-            q, k, v, True, 512, 1024).sum()
+            q, k, v, True, block_q, block_k).sum()
         loss_def = lambda q, k, v: flash_attention(
-            q, k, v, True, 512, 1024, None, "default").sum()
+            q, k, v, True, block_q, block_k, None, "default").sum()
         loss_d = lambda q, k, v: attention_reference(
             q, k, v, causal=True).sum()
 
-        # grad agreement OUTSIDE the timed chains
-        gf = jax.jit(jax.grad(loss_hi, argnums=(0, 1, 2)))(q, k, v)
-        gd = jax.jit(jax.grad(loss_d, argnums=(0, 1, 2)))(q, k, v)
-        rel = max(
-            float(jnp.abs(a - b).max() / (jnp.abs(b).max() + 1e-9))
-            for a, b in zip(gf, gd)
-        )
-        assert rel < 5e-4, f"flash grads diverged at T={T}: rel={rel:.2e}"
+        # grad agreement OUTSIDE the timed chains; the dense reference
+        # gradient is itself multi-GB at T=8192 — if IT cannot run, the
+        # flash rows must survive (same per-harness discipline as below),
+        # with the T=4096 agreement standing as the correctness evidence
+        rel = None
+        try:
+            gf = jax.jit(jax.grad(loss_hi, argnums=(0, 1, 2)))(q, k, v)
+            gd = jax.jit(jax.grad(loss_d, argnums=(0, 1, 2)))(q, k, v)
+            rel = max(
+                float(jnp.abs(a - b).max() / (jnp.abs(b).max() + 1e-9))
+                for a, b in zip(gf, gd)
+            )
+        except Exception as e:  # noqa: BLE001 - reported in the row
+            if T == 4096:
+                raise  # the small shape MUST agree — that's the gate
+            grad_check_err = f"{type(e).__name__}: {e}"[:200]
+        if rel is not None:
+            assert rel < 5e-4, f"flash grads diverged at T={T}: rel={rel:.2e}"
 
         def measured(step_fn, ceiling, reps=reps, retries=1):
             """(ms, tflops, physical): re-measure once on an unphysical
@@ -223,9 +233,13 @@ def flash_train_faceoff(B=2, H=8, D=64):
             "tflops_default": round(tf_def, 1),
             "mfu_highest": round(tf_hi / V5E_PEAK_F32_TFLOPS, 3),
             "mfu_default": round(tf_def / V5E_PEAK_BF16_TFLOPS, 3),
-            "grad_max_rel_err_highest": float(f"{rel:.2e}"),
+            "grad_max_rel_err_highest": (
+                float(f"{rel:.2e}") if rel is not None else None
+            ),
             "physical": {"highest": ok_hi, "default": ok_def, "dense": ok_d},
         }
+        if rel is None:
+            row["grad_check_error"] = grad_check_err
         if dense_errs:
             row["dense_errors"] = dense_errs
         if ok_hi and ok_d:

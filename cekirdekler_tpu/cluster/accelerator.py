@@ -89,6 +89,31 @@ class ClusterAccelerator(IComputeNode):
         with ThreadPoolExecutor(max_workers=min(64, max(1, len(candidates)))) as pool:
             return [ep for ep in pool.map(try_one, candidates) if ep is not None]
 
+    @classmethod
+    def discover(
+        cls, port: int, subnet: str | None = None, timeout: float = 0.5,
+    ) -> list[tuple[str, int]]:
+        """LAN discovery parity (reference: findServer probes all 255 host
+        addresses of the local /24 in parallel and keeps responders,
+        ClusterAccelerator.cs:77-155).  ``subnet`` like ``"192.168.1"``;
+        None derives it from this host's primary address.  Coordinator
+        address lists are the TPU-pod idiom — this exists for the ad-hoc
+        LAN fleets the TCP tier serves."""
+        import socket
+
+        if subnet is None:
+            # the UDP "connect" assigns the outbound interface without
+            # sending a packet — the portable local-address trick
+            with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+                try:
+                    s.connect(("10.255.255.255", 1))
+                    local_ip = s.getsockname()[0]
+                except OSError:
+                    local_ip = "127.0.0.1"
+            subnet = local_ip.rsplit(".", 1)[0]
+        candidates = [(f"{subnet}.{h}", port) for h in range(1, 256)]
+        return cls.probe(candidates, timeout=timeout)
+
     # -- IComputeNode --------------------------------------------------------
     def setup_nodes(self, kernel_source: str) -> None:
         """Ship the kernel source to every node and build the local

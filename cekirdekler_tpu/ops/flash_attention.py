@@ -35,7 +35,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.experimental import pallas as pl
 
-__all__ = ["flash_attention", "flash_attention_parts", "auto_block"]
+__all__ = ["flash_attention", "flash_attention_parts",
+           "flash_attention_bwd_parts", "auto_block"]
 
 _NEG = -1e30  # finite "-inf": exp(_NEG - m) == 0 without nan hazards
 
@@ -49,7 +50,8 @@ def auto_block(T: int, target: int = 512, floor: int = 8) -> int | None:
     The 512 default target comes from an on-chip block sweep (T=4096,
     D=64, f32): small 128² blocks leave the MXU ~6% utilized (the
     per-block softmax VPU work dominates); 256-1024 element blocks are
-    1.5-3x faster, with q=256/k=512 the fwd+bwd sweet spot."""
+    1.5-3x faster, with q=512/k=512 the fwd+bwd sweet spot (r5
+    full-gradient sweep)."""
     blk = math.gcd(T, target)
     return blk if blk >= floor else None
 
@@ -170,26 +172,22 @@ def _blocks_for(Tq: int, Tk: int, block_q: int, block_k: int):
     degrade gracefully for any T a smaller block would have handled
     (e.g. T=640 with the 256 default -> 128).
 
-    Below a quarter of the smaller requested block, the gcd path falls
-    back per axis to :func:`auto_block` (largest power-of-two divisor of
-    T), floored at 32: short sequences like T=32 or T=96 that the old
-    128/128 defaults accepted keep working after the 256/512 retune
-    (r4 advisor note), while genuinely awkward lengths (T=4104 → 8-wide
-    tiles, ~100x slower than the dense einsum this replaces) still raise
-    loudly rather than run silently degenerate."""
+    The degradation floor is a quarter of the smaller requested block,
+    capped at 32 rows/columns: default-argument calls for short
+    sequences like T=32 or T=96 keep working after the block retunes
+    (r4 advisor note), explicitly-requested tiny blocks (e.g. 16/16 in
+    tests) are honored, and genuinely awkward lengths (T=4104 → 8-wide
+    tiles under the defaults, ~100x slower than the dense einsum this
+    replaces) raise loudly rather than run silently degenerate."""
     bq = math.gcd(Tq, block_q)
     bk = math.gcd(Tk, block_k)
-    floor = max(8, min(block_q, block_k) // 4)
+    floor = min(32, max(8, min(block_q, block_k) // 4))
     if bq < floor or bk < floor:
-        bq2 = auto_block(Tq, target=block_q, floor=32)
-        bk2 = auto_block(Tk, target=block_k, floor=32)
-        if bq2 is None or bk2 is None:
-            raise ValueError(
-                f"sequence lengths (Tq={Tq}, Tk={Tk}) admit only degenerate "
-                f"tiles ({bq}, {bk}) for requested blocks ({block_q}, "
-                f"{block_k}); use auto_block() or pad the sequence"
-            )
-        bq, bk = bq2, bk2
+        raise ValueError(
+            f"sequence lengths (Tq={Tq}, Tk={Tk}) admit only degenerate "
+            f"tiles ({bq}, {bk}) for requested blocks ({block_q}, "
+            f"{block_k}); use auto_block() or pad the sequence"
+        )
     return bq, bk
 
 
@@ -339,12 +337,25 @@ def flash_attention_parts(
     return acc, m, l
 
 
-def _fa_bwd_dq_kernel(*refs, scale, block_q, block_k, n_kb, causal, precision):
+def _fa_bwd_dq_kernel(*refs, scale, block_q, block_k, n_kb, causal, precision,
+                      parts=False):
     """Backward dq: grid (bh, q-block, k-block minor).  Recomputes each
     score block from q/k and the saved logsumexp, accumulates
-    dq += ds · K in VMEM scratch across the k steps."""
-    q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref, dq_ref = refs[:7]
-    (dq_scr,) = refs[7:]
+    dq += ds · K in VMEM scratch across the k steps.
+
+    ``parts=True`` prepends two SMEM scalars (global position offsets of
+    this chip's Q and the in-flight K/V block) shifting the causal mask —
+    the ring backward's analogue of the parts forward kernel."""
+    if parts:
+        q_off_ref, k_off_ref = refs[0], refs[1]
+        q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref, dq_ref = refs[2:9]
+        (dq_scr,) = refs[9:]
+        q_pos0 = q_off_ref[0, 0]
+        k_pos0 = k_off_ref[0, 0]
+    else:
+        q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref, dq_ref = refs[:7]
+        (dq_scr,) = refs[7:]
+        q_pos0 = k_pos0 = 0
     qi = pl.program_id(1)
     kj = pl.program_id(2)
 
@@ -352,7 +363,11 @@ def _fa_bwd_dq_kernel(*refs, scale, block_q, block_k, n_kb, causal, precision):
     def _init():
         dq_scr[...] = jnp.zeros_like(dq_scr)
 
-    live = (kj * block_k <= qi * block_q + block_q - 1) if causal else True
+    live = (
+        (k_pos0 + kj * block_k <= q_pos0 + qi * block_q + block_q - 1)
+        if causal
+        else True
+    )
 
     @pl.when(live)
     def _step():
@@ -367,9 +382,9 @@ def _fa_bwd_dq_kernel(*refs, scale, block_q, block_k, n_kb, causal, precision):
             preferred_element_type=jnp.float32, precision=precision,
         )
         if causal:
-            q_pos = qi * block_q + lax.broadcasted_iota(
+            q_pos = q_pos0 + qi * block_q + lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
-            k_pos = kj * block_k + lax.broadcasted_iota(
+            k_pos = k_pos0 + kj * block_k + lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(k_pos <= q_pos, s, _NEG)
         p = jnp.exp(s - lse[:, None])                  # (bq, bk)
@@ -389,11 +404,23 @@ def _fa_bwd_dq_kernel(*refs, scale, block_q, block_k, n_kb, causal, precision):
 
 
 def _fa_bwd_dkv_kernel(*refs, scale, block_q, block_k, n_qb, causal,
-                       precision):
+                       precision, parts=False):
     """Backward dk/dv: grid (bh, k-block, q-block minor).  Accumulates
-    dv += pᵀ · dO and dk += dsᵀ · q in VMEM scratch across the q steps."""
-    q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref, dk_ref, dv_ref = refs[:8]
-    dk_scr, dv_scr = refs[8:]
+    dv += pᵀ · dO and dk += dsᵀ · q in VMEM scratch across the q steps.
+
+    ``parts=True``: SMEM global position offsets, as in the dq kernel."""
+    if parts:
+        q_off_ref, k_off_ref = refs[0], refs[1]
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref, dk_ref,
+         dv_ref) = refs[2:10]
+        dk_scr, dv_scr = refs[10:]
+        q_pos0 = q_off_ref[0, 0]
+        k_pos0 = k_off_ref[0, 0]
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref, dk_ref,
+         dv_ref) = refs[:8]
+        dk_scr, dv_scr = refs[8:]
+        q_pos0 = k_pos0 = 0
     kj = pl.program_id(1)
     qi = pl.program_id(2)
 
@@ -402,7 +429,11 @@ def _fa_bwd_dkv_kernel(*refs, scale, block_q, block_k, n_qb, causal,
         dk_scr[...] = jnp.zeros_like(dk_scr)
         dv_scr[...] = jnp.zeros_like(dv_scr)
 
-    live = (kj * block_k <= qi * block_q + block_q - 1) if causal else True
+    live = (
+        (k_pos0 + kj * block_k <= q_pos0 + qi * block_q + block_q - 1)
+        if causal
+        else True
+    )
 
     @pl.when(live)
     def _step():
@@ -417,9 +448,9 @@ def _fa_bwd_dkv_kernel(*refs, scale, block_q, block_k, n_qb, causal,
             preferred_element_type=jnp.float32, precision=precision,
         )
         if causal:
-            q_pos = qi * block_q + lax.broadcasted_iota(
+            q_pos = q_pos0 + qi * block_q + lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
-            k_pos = kj * block_k + lax.broadcasted_iota(
+            k_pos = k_pos0 + kj * block_k + lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(k_pos <= q_pos, s, _NEG)
         p = jnp.exp(s - lse[:, None])                  # (bq, bk)
@@ -516,6 +547,100 @@ def _flash_backward(q, k, v, out, lse3, do, causal, block_q, block_k,
     return reshape(dq, Tq), reshape(dk, Tk), reshape(dv, Tk)
 
 
+@functools.partial(
+    jax.jit,
+    static_argnames=("causal", "block_q", "block_k", "interpret", "precision"),
+)
+def flash_attention_bwd_parts(
+    q, k, v, do, lse, delta, q_pos0=0, k_pos0=0, causal=False,
+    block_q=128, block_k=128, interpret=None, precision="highest",
+):
+    """Ring-attention inner BACKWARD: gradients of one chip's queries
+    against one in-flight K/V block, with runtime global position offsets
+    for the causal mask — the bwd analogue of
+    :func:`flash_attention_parts` (same tiled kernels as the single-chip
+    backward, SMEM offsets added).
+
+    ``lse`` and ``delta`` are per-row [B, Tq, H] f32: the ring-global
+    logsumexp (m + log l merged across ALL ring steps) and
+    rowsum(dO ∘ O).  Returns ``(dq_partial, dk_block, dv_block)`` — the
+    caller sums dq over ring steps and rotates dk/dv accumulators with
+    their blocks (parallel/attention.py:_raf_bwd)."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    interpret, prec = _resolve(interpret, precision)
+    B, Tq, H, D = q.shape
+    Tk = k.shape[1]
+    scale = 1.0 / math.sqrt(D)
+    bq = min(block_q, Tq)
+    bk = min(block_k, Tk)
+    if Tq % bq or Tk % bk:
+        raise ValueError(
+            f"sequence lengths (Tq={Tq}, Tk={Tk}) must be multiples of the "
+            f"blocks (bq={bq}, bk={bk})"
+        )
+    q3 = q.transpose(0, 2, 1, 3).reshape(B * H, Tq, D)
+    k3 = k.transpose(0, 2, 1, 3).reshape(B * H, Tk, D)
+    v3 = v.transpose(0, 2, 1, 3).reshape(B * H, Tk, D)
+    do3 = do.transpose(0, 2, 1, 3).reshape(B * H, Tq, D)
+    to_lanes = lambda a: jnp.broadcast_to(
+        a.astype(jnp.float32).transpose(0, 2, 1).reshape(B * H, Tq, 1),
+        (B * H, Tq, 128),
+    )
+    lse3 = to_lanes(lse)
+    dlt3 = to_lanes(delta)
+    offs = (
+        jnp.asarray(q_pos0, jnp.int32).reshape(1, 1),
+        jnp.asarray(k_pos0, jnp.int32).reshape(1, 1),
+    )
+    sds = _vma_sds(q3, k3, v3, do3)
+    n_qb, n_kb = Tq // bq, Tk // bk
+    scalar_spec = pl.BlockSpec((1, 1), lambda b, i, j: (0, 0),
+                               memory_space=pltpu.SMEM)
+    tile_q = pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0))
+    tile_ml = pl.BlockSpec((1, bq, 128), lambda b, i, j: (b, i, 0))
+    tile_k_minor = pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0))
+    dq = pl.pallas_call(
+        functools.partial(
+            _fa_bwd_dq_kernel, scale=scale, block_q=bq, block_k=bk,
+            n_kb=n_kb, causal=causal, precision=prec, parts=True,
+        ),
+        grid=(B * H, n_qb, n_kb),
+        in_specs=[scalar_spec, scalar_spec, tile_q, tile_k_minor,
+                  tile_k_minor, tile_q, tile_ml, tile_ml],
+        out_specs=tile_q,
+        out_shape=sds((B * H, Tq, D), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
+        interpret=interpret,
+    )(*offs, q3, k3, v3, do3, lse3, dlt3)
+    tile_q_minor = pl.BlockSpec((1, bq, D), lambda b, j, i: (b, i, 0))
+    tile_ml_minor = pl.BlockSpec((1, bq, 128), lambda b, j, i: (b, i, 0))
+    tile_k = pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0))
+    scalar_spec_m = pl.BlockSpec((1, 1), lambda b, j, i: (0, 0),
+                                 memory_space=pltpu.SMEM)
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _fa_bwd_dkv_kernel, scale=scale, block_q=bq, block_k=bk,
+            n_qb=n_qb, causal=causal, precision=prec, parts=True,
+        ),
+        grid=(B * H, n_kb, n_qb),
+        in_specs=[scalar_spec_m, scalar_spec_m, tile_q_minor, tile_k,
+                  tile_k, tile_q_minor, tile_ml_minor, tile_ml_minor],
+        out_specs=[tile_k, tile_k],
+        out_shape=[
+            sds((B * H, Tk, D), k.dtype),
+            sds((B * H, Tk, D), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, D), jnp.float32),
+            pltpu.VMEM((bk, D), jnp.float32),
+        ],
+        interpret=interpret,
+    )(*offs, q3, k3, v3, do3, lse3, dlt3)
+    reshape = lambda a, T: a.reshape(B, H, T, D).transpose(0, 2, 1, 3)
+    return reshape(dq, Tq), reshape(dk, Tk), reshape(dv, Tk)
+
+
 def _dense_f32(q, k, v, causal, prec=lax.Precision.HIGHEST):
     """Score/probability recompute used by the backward (plain XLA)."""
     scale = 1.0 / math.sqrt(q.shape[-1])
@@ -533,7 +658,7 @@ def _dense_f32(q, k, v, causal, prec=lax.Precision.HIGHEST):
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def flash_attention(q, k, v, causal=False, block_q=256, block_k=512,
+def flash_attention(q, k, v, causal=False, block_q=512, block_k=512,
                     interpret=None, precision="highest"):
     """Tiled flash attention on TPU (Pallas), fwd AND bwd kernels.
 
@@ -543,10 +668,11 @@ def flash_attention(q, k, v, causal=False, block_q=256, block_k=512,
     ``precision``: "highest" (true-f32 MXU passes, matches the dense
     reference bit-for-bit-ish) or "default" (bf16 MXU passes — the usual
     flash-attention trade, ~1e-2 relative on f32 inputs, ~2x faster).
-    Default blocks (256/512) are the measured fwd+bwd sweet spot (see
-    :func:`auto_block`); training memory is O(T) residuals (out + per-row
-    logsumexp) + O(block²) tiles — no [T, T] materialization in either
-    direction."""
+    Default blocks (512/512) are the measured fwd+bwd sweet spot from the
+    r5 full-gradient sweep (tools/flash_sweep.py — the r4 256/512 pick
+    predates the anti-DCE harness fix and measured a pruned backward);
+    training memory is O(T) residuals (out + per-row logsumexp) +
+    O(block²) tiles — no [T, T] materialization in either direction."""
     interpret, prec = _resolve(interpret, precision)
     return _flash_forward(q, k, v, causal, block_q, block_k, interpret, prec)
 

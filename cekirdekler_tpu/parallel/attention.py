@@ -96,9 +96,11 @@ def ring_attention(q, k, v, axis: str, causal: bool = False,
     Pallas parts kernel (ops/flash_attention.py:flash_attention_parts,
     unnormalized accumulator + running max/denominator merged across
     steps) instead of einsums.  Differentiable: the flash ring carries a
-    custom_vjp whose backward is the einsum ring body's VJP (the parts
-    kernel itself has no VJP) — forward keeps the flash win, training
-    gets correct gradients at einsum-path cost.
+    custom_vjp whose backward is ALSO flash (r5) — the tiled Pallas
+    backward kernels run per ring step off the saved ring-global
+    logsumexp, with dk/dv accumulators rotating alongside their blocks,
+    so training pays no einsum-ring recompute and never materializes a
+    [Tq, Tb] score block in either direction.
     """
     if flash:
         from ..ops.flash_attention import auto_block
@@ -144,12 +146,12 @@ def _ring_attention_einsum(q, k, v, axis: str, causal: bool):
     return out.transpose(0, 2, 1, 3).astype(q.dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def _ring_attention_flash(q, k, v, axis: str, causal: bool):
+def _ring_flash_fwd_impl(q, k, v, axis: str, causal: bool):
     """Flash-inner ring body: per step the in-flight K/V block feeds the
     parts kernel with its GLOBAL position offset (the ring rotates
     blocks, the causal mask follows), and the unnormalized results merge
-    with the standard stable-softmax combine."""
+    with the standard stable-softmax combine.  Returns ``(out, lse)`` —
+    the ring-global logsumexp is the backward's residual."""
     from ..ops.flash_attention import auto_block, flash_attention_parts
 
     n = lax.axis_size(axis)
@@ -181,23 +183,65 @@ def _ring_attention_flash(q, k, v, axis: str, causal: bool):
         return o, m_new, l, kc, vc
 
     o, m, l, _, _ = lax.fori_loop(0, n, body, (o, m, l, k, v))
-    return (o / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)
+    out = (o / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)
+    lse = m + jnp.log(jnp.maximum(l, 1e-30))         # [B,Tq,H] f32
+    return out, lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _ring_attention_flash(q, k, v, axis: str, causal: bool):
+    return _ring_flash_fwd_impl(q, k, v, axis, causal)[0]
 
 
 def _raf_fwd(q, k, v, axis, causal):
-    return _ring_attention_flash(q, k, v, axis, causal), (q, k, v)
+    out, lse = _ring_flash_fwd_impl(q, k, v, axis, causal)
+    return out, (q, k, v, out, lse)
 
 
 def _raf_bwd(axis, causal, res, do):
-    # the einsum ring computes the same function (stable softmax over the
-    # ring), so its VJP is the correct gradient; the parts kernel has no
-    # VJP of its own — without this, jax.grad died deep inside pallas_call
-    # with an opaque error (ADVICE r3 #2)
-    q, k, v = res
-    _, vjp = jax.vjp(
-        lambda a, b, c: _ring_attention_einsum(a, b, c, axis, causal), q, k, v
+    """Flash ring BACKWARD (r4 advisor follow-up): the tiled Pallas
+    backward kernels run per ring step off the saved ring-global
+    logsumexp — no einsum-ring forward recompute, no [Tq, Tb] score
+    materialization.  dq accumulates locally; the dk/dv accumulators
+    ROTATE WITH their K/V blocks, so after the full ring each block's
+    gradient arrives back at its home chip with every chip's
+    contribution summed (the standard ring-attention backward)."""
+    from ..ops.flash_attention import auto_block, flash_attention_bwd_parts
+
+    q, k, v, out, lse = res
+    n = lax.axis_size(axis)
+    r = lax.axis_index(axis)
+    B, Tq, H, D = q.shape
+    Tb = k.shape[1]
+    bq = auto_block(Tq)
+    bk = auto_block(Tb)
+    delta = jnp.einsum(
+        "bqhd,bqhd->bqh", do.astype(jnp.float32), out.astype(jnp.float32),
+        precision=_PREC,
     )
-    return vjp(do)
+    q_pos0 = r * Tq
+    dq0 = q.astype(jnp.float32) * 0.0
+    dk0 = k.astype(jnp.float32) * 0.0
+
+    def body(i, carry):
+        dq, dkc, dvc, kc, vc = carry
+        src = (r - i) % n
+        dq_i, dk_i, dv_i = flash_attention_bwd_parts(
+            q, kc, vc, do, lse, delta, q_pos0, src * Tb, causal, bq, bk,
+        )
+        dq = dq + dq_i.astype(jnp.float32)
+        dkc = dkc + dk_i.astype(jnp.float32)
+        dvc = dvc + dv_i.astype(jnp.float32)
+        kc = ppermute_ring(kc, axis, 1)
+        vc = ppermute_ring(vc, axis, 1)
+        dkc = ppermute_ring(dkc, axis, 1)
+        dvc = ppermute_ring(dvc, axis, 1)
+        return dq, dkc, dvc, kc, vc
+
+    dq, dk, dv, _, _ = lax.fori_loop(
+        0, n, body, (dq0, dk0, dk0, k, v)
+    )
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
 _ring_attention_flash.defvjp(_raf_fwd, _raf_bwd)

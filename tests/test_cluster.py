@@ -180,6 +180,14 @@ def test_probe_finds_live_servers(two_servers):
     assert ("127.0.0.1", 1) not in live
 
 
+def test_discover_scans_subnet(two_servers):
+    """LAN discovery parity (findServer, ClusterAccelerator.cs:77-155):
+    probing all 255 host addresses of a subnet finds the live server."""
+    s1, _ = two_servers
+    live = ClusterAccelerator.discover(s1.port, subnet="127.0.0", timeout=0.3)
+    assert ("127.0.0.1", s1.port) in live
+
+
 def test_cluster_across_real_processes():
     """A server in a SEPARATE python process (true serialization + GIL
     boundary, the reference's actual deployment shape): the cluster

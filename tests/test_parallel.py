@@ -150,9 +150,10 @@ def test_ring_flash_inner_matches_reference(causal):
 @pytest.mark.parametrize("causal", [False, True])
 def test_ring_flash_gradients(causal):
     """ring_attention(flash=True) is differentiable (r3 ADVICE: it used
-    to die inside pallas_call): the custom_vjp routes the backward
-    through the einsum ring body, so grads must match the dense
-    reference."""
+    to die inside pallas_call): the custom_vjp backward is the tiled
+    Pallas ring backward (r5 — per-step bwd kernels off the ring-global
+    logsumexp, dk/dv accumulators rotating with their blocks), so grads
+    must match the dense reference."""
     mesh = par.make_mesh(_cpu_devices(4), sp=4)
     rng = np.random.default_rng(13)
     B, T, H, D = 1, 64, 2, 8

@@ -13,14 +13,11 @@ from __future__ import annotations
 
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import lax
 
 
 def stencil_src(taps: list[int]) -> str:
@@ -33,23 +30,17 @@ def stencil_src(taps: list[int]) -> str:
 
 
 def bench(fn, arrs, reps, rtt):
-    @jax.jit
-    def run(arrs):
-        def step(j, cur):
-            out = fn(0, cur, ())
-            return (out[1], cur[0])  # q feeds back as next p
-        return lax.fori_loop(0, reps, step, tuple(arrs))
+    """Shared harness, structural carry: the stencil output feeds back as
+    the next input (q becomes p) — see fori_chain_bench's carry arg."""
+    from cekirdekler_tpu.workloads import fori_chain_bench
 
-    cur = run(tuple(arrs))
-    np.asarray(cur[0][:8])
-    best = float("inf")
-    for _ in range(3):
-        t0 = time.perf_counter()
-        cur = run(tuple(cur))
-        np.asarray(cur[0][:8])
-        wall = time.perf_counter() - t0
-        best = min(best, max(wall - rtt, wall * 0.05) / reps)
-    return best
+    return fori_chain_bench(
+        lambda *c: fn(0, c, ()),
+        arrs,
+        reps,
+        rtt=rtt,
+        carry=lambda c, out: (out[1], c[0]),
+    )
 
 
 def main(Ks=(2, 4, 8, 16, 24), n=1 << 24, reps=192):
