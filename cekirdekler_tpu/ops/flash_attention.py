@@ -170,7 +170,7 @@ def _blocks_for(Tq: int, Tk: int, block_q: int, block_k: int):
     """Effective (bq, bk): the largest divisors of the sequence lengths
     not exceeding the requested blocks (gcd) — so default-argument calls
     degrade gracefully for any T a smaller block would have handled
-    (e.g. T=640 with the 256 default -> 128).
+    (e.g. T=640 with the 512/512 defaults -> 128-wide tiles).
 
     The degradation floor is a quarter of the smaller requested block,
     capped at 32 rows/columns: default-argument calls for short
@@ -563,9 +563,13 @@ def flash_attention_bwd_parts(
 
     ``lse`` and ``delta`` are per-row [B, Tq, H] f32: the ring-global
     logsumexp (m + log l merged across ALL ring steps) and
-    rowsum(dO ∘ O).  Returns ``(dq_partial, dk_block, dv_block)`` — the
-    caller sums dq over ring steps and rotates dk/dv accumulators with
-    their blocks (parallel/attention.py:_raf_bwd)."""
+    rowsum(dO ∘ O).  Returns ``(dq_partial, dk_block, dv_block)`` in
+    **f32** regardless of input dtype — the caller accumulates partials
+    across ring steps, and rounding each partial to a low-precision
+    input dtype would add n independent roundings the single-chip
+    backward doesn't have (it rounds once from f32 scratch).  The caller
+    sums dq over ring steps and rotates dk/dv accumulators with their
+    blocks (parallel/attention.py:_raf_bwd)."""
     from jax.experimental.pallas import tpu as pltpu
 
     interpret, prec = _resolve(interpret, precision)
@@ -609,7 +613,7 @@ def flash_attention_bwd_parts(
         in_specs=[scalar_spec, scalar_spec, tile_q, tile_k_minor,
                   tile_k_minor, tile_q, tile_ml, tile_ml],
         out_specs=tile_q,
-        out_shape=sds((B * H, Tq, D), q.dtype),
+        out_shape=sds((B * H, Tq, D), jnp.float32),
         scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
         interpret=interpret,
     )(*offs, q3, k3, v3, do3, lse3, dlt3)
@@ -628,8 +632,8 @@ def flash_attention_bwd_parts(
                   tile_k, tile_q_minor, tile_ml_minor, tile_ml_minor],
         out_specs=[tile_k, tile_k],
         out_shape=[
-            sds((B * H, Tk, D), k.dtype),
-            sds((B * H, Tk, D), v.dtype),
+            sds((B * H, Tk, D), jnp.float32),
+            sds((B * H, Tk, D), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((bk, D), jnp.float32),

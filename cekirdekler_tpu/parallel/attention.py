@@ -222,6 +222,7 @@ def _raf_bwd(axis, causal, res, do):
     q_pos0 = r * Tq
     dq0 = q.astype(jnp.float32) * 0.0
     dk0 = k.astype(jnp.float32) * 0.0
+    dv0 = v.astype(jnp.float32) * 0.0
 
     def body(i, carry):
         dq, dkc, dvc, kc, vc = carry
@@ -239,7 +240,7 @@ def _raf_bwd(axis, causal, res, do):
         return dq, dkc, dvc, kc, vc
 
     dq, dk, dv, _, _ = lax.fori_loop(
-        0, n, body, (dq0, dk0, dk0, k, v)
+        0, n, body, (dq0, dk0, dv0, k, v)
     )
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 

@@ -1243,7 +1243,7 @@ def dtype_lowering_matrix(
         ("mixed-f16-float", np.float16, "float"),    # mixed-boundary row
     ]
     t_start = time.monotonic()
-    table: dict = {}
+    table: dict = {label: {} for label, _, _ in rows}
 
     def oracle(a_host, storage, ct):
         # compute in the declared type, store back in the storage type —
@@ -1258,7 +1258,7 @@ def dtype_lowering_matrix(
         acc = a_host.astype(decl_np) * decl_np(2) + decl_np(3)
         return acc.astype(storage)
 
-    for label, storage, ct in rows:
+    def prep(label, storage, ct):
         src = (
             f"__kernel void gen(__global {ct}* a, __global {ct}* b) "
             "{ int i = get_global_id(0); "
@@ -1275,25 +1275,11 @@ def dtype_lowering_matrix(
             want_x32 = want.astype(
                 np.int32 if sdt.kind in "iu" else np.float32
             )
-        row: dict = {}
-
-        def run_cell(name, fn):
-            if time.monotonic() - t_start > budget_sec:
-                row[name] = "skipped (budget)"
-                return
-            try:
-                row[name] = fn()
-            except PallasUnsupported as e:
-                row[name] = f"veto: {e}"[:80]
-            except Exception as e:  # noqa: BLE001 - the cell IS the report
-                row[name] = f"fail: {type(e).__name__}: {e}"[:120]
 
         def match(got) -> str:
             got = np.asarray(got)
             ref = want_x32 if got.dtype != sdt else want
-            if got.dtype == ref.dtype and np.array_equal(
-                got, ref
-            ):
+            if got.dtype == ref.dtype and np.array_equal(got, ref):
                 return "pass" if got.dtype == sdt else "pass-x32"
             # float dtypes: the declared-type arithmetic may round
             # differently on the VPU — accept 1-ulp-scale error
@@ -1306,43 +1292,59 @@ def dtype_lowering_matrix(
                     return ("pass" if got.dtype == sdt else "pass-x32")
             return f"fail: mismatch (got {got.dtype}, want {ref.dtype})"
 
-        def xla_cell():
-            fn, _ = codegen.build_kernel_fn(kdef, n, local_range, n)
-            arrs = (jnp.asarray(a_host), jnp.zeros(n, jnp.asarray(a_host).dtype))
-            out = jax.jit(fn)(0, arrs, ())
-            return match(out[1])
+        return src, kdef, a_host, storage, match, label
 
-        def pallas_cell():
-            fn, _ = build_kernel_fn_pallas(
-                kdef, n, local_range, n, force=True
+    def xla_cell(p):
+        src, kdef, a_host, storage, match, label = p
+        fn, _ = codegen.build_kernel_fn(kdef, n, local_range, n)
+        arrs = (jnp.asarray(a_host), jnp.zeros(n, jnp.asarray(a_host).dtype))
+        out = jax.jit(fn)(0, arrs, ())
+        return match(out[1])
+
+    def pallas_cell(p):
+        src, kdef, a_host, storage, match, label = p
+        fn, _ = build_kernel_fn_pallas(kdef, n, local_range, n, force=True)
+        arrs = (jnp.asarray(a_host), jnp.zeros(n, jnp.asarray(a_host).dtype))
+        out = jax.jit(fn)(0, arrs, ())
+        return match(out[1])
+
+    def harness_cell(p):
+        from .hardware import all_devices
+
+        src, kdef, a_host, storage, match, label = p
+        devs = all_devices()
+        devs = devs.tpus() or devs.cpus().subset(1)
+        a = ClArray(a_host.copy(), name=f"dm_a_{label}",
+                    partial_read=True, read_only=True)
+        b = ClArray(np.zeros(n, storage), name=f"dm_b_{label}",
+                    write_only=True)
+        cr = NumberCruncher(devs, src)
+        try:
+            a.next_param(b).compute(
+                cr, 7300, "gen", n, local_range,
+                pipeline=True, pipeline_blobs=4,
             )
-            arrs = (jnp.asarray(a_host), jnp.zeros(n, jnp.asarray(a_host).dtype))
-            out = jax.jit(fn)(0, arrs, ())
-            return match(out[1])
+            return match(b.host())
+        finally:
+            cr.dispose()
 
-        def harness_cell():
-            from .hardware import all_devices
-
-            devs = all_devices()
-            devs = devs.tpus() or devs.cpus().subset(1)
-            a = ClArray(a_host.copy(), name=f"dm_a_{label}",
-                        partial_read=True, read_only=True)
-            b = ClArray(np.zeros(n, storage), name=f"dm_b_{label}",
-                        write_only=True)
-            cr = NumberCruncher(devs, src)
+    prepped = {label: prep(label, storage, ct) for label, storage, ct in rows}
+    # MODE-major iteration: when the budget bites mid-sweep, full dtype
+    # coverage of the earlier lowerings survives and only the trailing
+    # mode column degrades — losing whole dtype ROWS (the r5 first cut's
+    # dtype-major order) throws away exactly the breadth the table is for
+    for mode, cell in (("xla", xla_cell), ("pallas", pallas_cell),
+                       ("harness_pipelined", harness_cell)):
+        for label, _, _ in rows:
+            if time.monotonic() - t_start > budget_sec:
+                table[label][mode] = "skipped (budget)"
+                continue
             try:
-                a.next_param(b).compute(
-                    cr, 7300, "gen", n, local_range,
-                    pipeline=True, pipeline_blobs=4,
-                )
-                return match(b.host())
-            finally:
-                cr.dispose()
-
-        run_cell("xla", xla_cell)
-        run_cell("pallas", pallas_cell)
-        run_cell("harness_pipelined", harness_cell)
-        table[label] = row
+                table[label][mode] = cell(prepped[label])
+            except PallasUnsupported as e:
+                table[label][mode] = f"veto: {e}"[:80]
+            except Exception as e:  # noqa: BLE001 - the cell IS the report
+                table[label][mode] = f"fail: {type(e).__name__}: {e}"[:120]
 
     n_pass = sum(
         1 for r in table.values() for v in r.values()
