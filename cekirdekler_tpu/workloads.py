@@ -14,6 +14,7 @@ iterations.
 
 from __future__ import annotations
 
+import functools
 import time
 from dataclasses import dataclass, field
 
@@ -1294,19 +1295,18 @@ def dtype_lowering_matrix(
 
         return src, kdef, a_host, storage, match, label
 
-    def xla_cell(p):
+    def lowered_cell(build, p):
         src, kdef, a_host, storage, match, label = p
-        fn, _ = codegen.build_kernel_fn(kdef, n, local_range, n)
+        fn, _ = build(kdef, n, local_range, n)
         arrs = (jnp.asarray(a_host), jnp.zeros(n, jnp.asarray(a_host).dtype))
         out = jax.jit(fn)(0, arrs, ())
         return match(out[1])
 
-    def pallas_cell(p):
-        src, kdef, a_host, storage, match, label = p
-        fn, _ = build_kernel_fn_pallas(kdef, n, local_range, n, force=True)
-        arrs = (jnp.asarray(a_host), jnp.zeros(n, jnp.asarray(a_host).dtype))
-        out = jax.jit(fn)(0, arrs, ())
-        return match(out[1])
+    xla_cell = functools.partial(lowered_cell, codegen.build_kernel_fn)
+    pallas_cell = functools.partial(
+        lowered_cell,
+        functools.partial(build_kernel_fn_pallas, force=True),
+    )
 
     def harness_cell(p):
         from .hardware import all_devices
