@@ -195,8 +195,7 @@ class Transformer:
             # O(block²) attention memory, fwd+bwd kernels, differentiable.
             from ..ops.flash_attention import auto_block, flash_attention
 
-            bq = auto_block(q.shape[1], 256)
-            bk = auto_block(q.shape[1], 512)
+            bq = bk = auto_block(q.shape[1])  # measured 512/512 sweet spot
             if bq is not None and mesh is None:
                 return flash_attention(q, k, v, True, bq, bk)
             if bq is not None and mesh is not None and (

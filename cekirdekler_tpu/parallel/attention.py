@@ -266,8 +266,7 @@ def ulysses_attention(q, k, v, axis: str, causal: bool = False,
     if flash:
         from ..ops.flash_attention import auto_block, flash_attention
 
-        bq = auto_block(q2.shape[1], 256)
-        bk = auto_block(q2.shape[1], 512)
+        bq = bk = auto_block(q2.shape[1])  # measured 512/512 sweet spot
         flash = bq is not None  # degenerate tiling → dense is faster
     if flash:
         o2 = flash_attention(q2, k2, v2, causal, bq, bk)

@@ -119,6 +119,9 @@ def main() -> None:
     ap.add_argument("--devices-per-proc", type=int, default=4)
     args = ap.parse_args()
     if args.worker is not None:
+        if args.coordinator is None and args.port == 0:
+            ap.error("hand-launched workers need --coordinator host:port "
+                     "(or --port from the self-launching parent)")
         worker(args.worker, args.procs, args.coordinator or
                f"localhost:{args.port}", args.devices_per_proc)
         return
@@ -158,7 +161,10 @@ def main() -> None:
         for p in procs:
             if p.poll() is None:
                 p.kill()
-                p.wait(timeout=10)
+                try:
+                    p.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    pass  # keep killing the rest; the OS reaps on exit
     sys.exit(rc)
 
 
