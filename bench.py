@@ -110,7 +110,16 @@ def flash_train_faceoff(B=2, H=8, D=64, block_q=512, block_k=512):
     RTT.  Dense ALSO gets a python-loop measurement and takes its best:
     XLA pessimizes the big [T,T] dense backward inside a while loop
     (9x at T=8192), and the baseline must be the best dense a user
-    could run, not the harness's worst."""
+    could run, not the harness's worst.
+
+    Round-6: the ``default`` rows exercise the bf16 end-to-end kernel
+    path (f32 inputs cast once at the XLA level, bf16 streamed through
+    fwd AND bwd kernels, f32 accumulators/grads) plus the compact
+    lse/delta operands and causal DMA elision — the r6 MFU levers.
+    Dense physicality is judged against the UN-halved flop count
+    (attention_reference computes all T² scores; ADVICE r5 #2), so a
+    transport-elided dense baseline can no longer pass the roofline
+    check and inflate flash speedups."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -145,14 +154,18 @@ def flash_train_faceoff(B=2, H=8, D=64, block_q=512, block_k=512):
         "shape": f"B{B} H{H} D{D} f32 causal, flash blocks {block_q}/{block_k}",
         "rtt_ms": round(rtt * 1e3, 1),
         "note": (
-            "highest = true-f32 MXU passes (grads match dense to ~5e-5), "
-            "MFU vs the multi-pass f32 ceiling (~peak/6); default = bf16 "
-            "MXU passes (the standard flash trade, ~1e-2 grad rel err), "
-            "MFU vs the bf16 peak. Tiled Pallas bwd either way: no [T,T] "
-            "materialization, O(T) residuals. dense_ms = best of "
-            "fori-loop and python-loop harnesses; physical=false flags a "
-            "row whose implied Tflop/s exceeds its roofline (transport "
-            "elision) — such rows are excluded from speedups."
+            "highest = true-f32 streams + multi-pass MXU (grads match "
+            "dense to ~5e-5), MFU vs the f32 ceiling (~peak/6); default "
+            "= bf16 END-TO-END (r6: f32 inputs cast once, bf16 streamed "
+            "through fwd+bwd kernels, f32 accumulators — the standard "
+            "flash trade, ~1e-2 grad rel err), MFU vs the bf16 peak. "
+            "Tiled Pallas bwd either way: no [T,T] materialization, "
+            "compact O(T) lse/delta operands, causal DMA elision. "
+            "dense_ms = best of fori-loop and python-loop harnesses; "
+            "physical=false flags a row whose implied Tflop/s exceeds "
+            "its roofline (transport elision, judged vs the UN-halved "
+            "dense flop count for dense rows) — such rows are excluded "
+            "from speedups."
         ),
     }
     for T, reps in ((4096, 32), (8192, 8)):
@@ -173,29 +186,48 @@ def flash_train_faceoff(B=2, H=8, D=64, block_q=512, block_k=512):
         # grad agreement OUTSIDE the timed chains; the dense reference
         # gradient is itself multi-GB at T=8192 — if IT cannot run, the
         # flash rows must survive (same per-harness discipline as below),
-        # with the T=4096 agreement standing as the correctness evidence
-        rel = None
-        try:
-            gf = jax.jit(jax.grad(loss_hi, argnums=(0, 1, 2)))(q, k, v)
-            gd = jax.jit(jax.grad(loss_d, argnums=(0, 1, 2)))(q, k, v)
-            rel = max(
+        # with the T=4096 agreement standing as the correctness evidence.
+        # One flash triple lives at a time (compare, free, next): the
+        # added bf16 comparison must not raise peak memory past what the
+        # r5 highest-only check fit in.
+        rel = rel_def = grad_check_err = None
+
+        def grad_rel(loss_fn, gd):
+            g = jax.jit(jax.grad(loss_fn, argnums=(0, 1, 2)))(q, k, v)
+            return max(
                 float(jnp.abs(a - b).max() / (jnp.abs(b).max() + 1e-9))
-                for a, b in zip(gf, gd)
+                for a, b in zip(g, gd)
             )
+
+        try:
+            gd = jax.jit(jax.grad(loss_d, argnums=(0, 1, 2)))(q, k, v)
+            rel = grad_rel(loss_hi, gd)
+            assert rel < 5e-4, f"flash grads diverged at T={T}: rel={rel:.2e}"
+            # the bf16-streamed path carries the documented ~1e-2 flash
+            # trade; 2e-2 is the regression gate (tests pin it too)
+            rel_def = grad_rel(loss_def, gd)
+            assert rel_def < 2e-2, (
+                f"bf16 flash grads diverged at T={T}: rel={rel_def:.2e}")
+            del gd
+        except AssertionError:
+            raise  # divergence is a real failure at any T
         except Exception as e:  # noqa: BLE001 - reported in the row
             if T == 4096:
                 raise  # the small shape MUST agree — that's the gate
             grad_check_err = f"{type(e).__name__}: {e}"[:200]
-        if rel is not None:
-            assert rel < 5e-4, f"flash grads diverged at T={T}: rel={rel:.2e}"
 
-        def measured(step_fn, ceiling, reps=reps, retries=1):
+        # dense physicality uses the UN-halved count: attention_reference
+        # computes all T² scores, so judging it against the causal-halved
+        # flops let a 2x transport-elided reading pass (ADVICE r5 #2)
+        dense_flops = 16 * B * H * T * T * D
+
+        def measured(step_fn, ceiling, reps=reps, retries=1, fl=flops):
             """(ms, tflops, physical): re-measure once on an unphysical
             reading, then flag it."""
             g = jax.grad(step_fn, argnums=(0, 1, 2))
             for _ in range(retries + 1):
                 dt = bench_loop(g, (q, k, v), reps=reps)
-                tf = flops / dt / 1e12
+                tf = fl / dt / 1e12
                 if tf <= ceiling:
                     return dt, tf, True
             return dt, tf, False
@@ -210,7 +242,8 @@ def flash_train_faceoff(B=2, H=8, D=64, block_q=512, block_k=512):
         dt_d_loop = dt_d_py = None
         try:
             dt_d_loop, _, _ = measured(loss_d, V5E_PEAK_F32_TFLOPS,
-                                       reps=max(4, reps // 2))
+                                       reps=max(4, reps // 2),
+                                       fl=dense_flops)
         except Exception as e:  # noqa: BLE001 - reported per-harness
             dense_errs.append(f"fori: {type(e).__name__}: {e}"[:200])
         try:
@@ -222,7 +255,8 @@ def flash_train_faceoff(B=2, H=8, D=64, block_q=512, block_k=512):
             dense_errs.append(f"pyloop: {type(e).__name__}: {e}"[:200])
         dts = [x for x in (dt_d_loop, dt_d_py) if x is not None]
         dt_d = min(dts) if dts else None
-        ok_d = dt_d is not None and flops / dt_d / 1e12 <= V5E_PEAK_F32_TFLOPS
+        ok_d = (dt_d is not None
+                and dense_flops / dt_d / 1e12 <= V5E_PEAK_F32_TFLOPS)
         row = {
             "flash_highest_ms": round(dt_hi * 1e3, 2),
             "flash_default_ms": round(dt_def * 1e3, 2),
@@ -236,9 +270,12 @@ def flash_train_faceoff(B=2, H=8, D=64, block_q=512, block_k=512):
             "grad_max_rel_err_highest": (
                 float(f"{rel:.2e}") if rel is not None else None
             ),
+            "grad_max_rel_err_default": (
+                float(f"{rel_def:.2e}") if rel_def is not None else None
+            ),
             "physical": {"highest": ok_hi, "default": ok_def, "dense": ok_d},
         }
-        if rel is None:
+        if grad_check_err is not None:
             row["grad_check_error"] = grad_check_err
         if dense_errs:
             row["dense_errors"] = dense_errs
@@ -397,6 +434,75 @@ def balancer_rig_section():
         return err
 
 
+class SectionScheduler:
+    """Soft-budget section runner with RESERVED slices (VERDICT r5 #1).
+
+    Two consecutive rounds starved the verdict-ordered tail sections
+    (``dtype_matrix``, ``marker_overhead``) behind the expensive flash
+    sweep: one global budget, no reservation, starved sections last.
+    Rules now:
+
+    - a section named in ``reserved`` is MUST-RUN: it executes regardless
+      of how much of the global budget earlier sections burned (each such
+      section bounds itself internally — dtype_matrix carries its own
+      420s budget, marker_overhead is seconds);
+    - every OTHER section's budget check subtracts the reservations of
+      the must-run sections that haven't run yet, so an expensive middle
+      section is skipped BEFORE it can eat the reserved tail;
+    - ``critical`` sections (the headline path) always run.
+
+    Exceptions are caught per-section into ``errors`` — the driver must
+    always receive its one JSON line.
+    """
+
+    def __init__(self, budget: float, reserved: dict | None = None,
+                 clock=time.monotonic):
+        self._clock = clock
+        self._t0 = clock()
+        self.budget = budget
+        self.reserved = dict(reserved or {})
+        self.errors: dict = {}
+
+    def spent(self) -> float:
+        return self._clock() - self._t0
+
+    def run(self, name, fn, default=None, critical=False):
+        must_run = name in self.reserved
+        self.reserved.pop(name, None)
+        # cap reservations at 60% of the budget so a small operator
+        # override (CK_BENCH_BUDGET_SEC below the reservation sum) still
+        # leaves best-effort sections a proportional window instead of
+        # skipping everything from t=0
+        reserve = min(sum(self.reserved.values()), 0.6 * self.budget)
+        if (not critical and not must_run
+                and self.spent() > self.budget - reserve):
+            self.errors[name] = (
+                f"skipped: {self.budget:.0f}s bench budget spent "
+                f"({reserve:.0f}s reserved for must-run sections)"
+            )
+            return default
+        try:
+            return fn()
+        except Exception as e:  # noqa: BLE001 - resilience boundary
+            self.errors[name] = f"{type(e).__name__}: {e}"[:500]
+            return default
+
+
+# must-run reservations: the two sections the r5 verdict ordered, plus
+# flash_train — the r6 acceptance-gate metric (T8192 mfu_default): all
+# three must reach the artifact even on a slow-tunnel day.  Their slices
+# are what OTHER sections' budget checks subtract (so best-effort middle
+# sections skip BEFORE eating the reserved tail); the sections themselves
+# bound their own runtime internally (fixed reps / internal budgets).
+# Sizing trade: 850s reserved of the 1500s default leaves best-effort
+# sections a 650s window (shrinking reservations release as must-runs
+# complete) — on a good day everything still runs (r5 pre-flash sections
+# fit well inside that); on a bad day the gates win, which is the
+# explicit priority ordering the r5 verdict asked for.
+RESERVED_SECTIONS = {"flash_train": 360.0, "marker_overhead": 60.0,
+                     "dtype_matrix": 430.0}
+
+
 _OVERLAP_KEYS = (
     "t_read_ms", "t_compute_ms", "t_write_ms", "t_pipelined_ms",
     "rtt_ms", "sample_spread", "heavy_iters",
@@ -436,22 +542,15 @@ def main() -> None:
     # bad day the full suite would outlive any driver timeout and deliver
     # NOTHING.  Once the budget is spent, remaining sections are skipped
     # (recorded as such) — a partial artifact beats a dead one.  Override
-    # with CK_BENCH_BUDGET_SEC.
-    errors: dict = {}
-    t_start = time.monotonic()
-    budget = float(os.environ.get("CK_BENCH_BUDGET_SEC", "1500"))
-
-    def section(name, fn, default=None, critical=False):
-        # the headline path (tuned_loop/framework) is exempt: a 0.0
-        # headline is worse than a late artifact
-        if not critical and time.monotonic() - t_start > budget:
-            errors[name] = f"skipped: {budget:.0f}s bench budget spent"
-            return default
-        try:
-            return fn()
-        except Exception as e:  # noqa: BLE001 - resilience boundary
-            errors[name] = f"{type(e).__name__}: {e}"[:500]
-            return default
+    # with CK_BENCH_BUDGET_SEC.  The verdict-ordered sections
+    # (RESERVED_SECTIONS) are must-run with reserved slices — the flash
+    # sweep can no longer starve them (VERDICT r5 #1, two rounds null).
+    sched = SectionScheduler(
+        float(os.environ.get("CK_BENCH_BUDGET_SEC", "1500")),
+        RESERVED_SECTIONS,
+    )
+    errors = sched.errors
+    section = sched.run
 
     # Baseline 1: the naive unscheduled loop — kernel-language program on
     # one chip, full image D2H + host sync every iteration.
@@ -630,6 +729,13 @@ def main() -> None:
         "nbody_gpairs_per_sec": round(nb["gpairs_per_sec"], 3),
         "nbody_checked": bool(nb["checked"]),
         "nbody_e2e": nbe,
+        "nbody_note": (
+            "nbody_gpairs_per_sec = sync-per-call variant (host fence "
+            "every iteration, RTT-bound — a dispatch-latency metric); "
+            "nbody_e2e = enqueue-window variant at reference scale (the "
+            "throughput metric). Device-level kernel throughput is "
+            "lowering_faceoff.nbody."
+        ),
         "hbm_stream_gbps": round(hbm_gbps, 1),
         "hbm_utilization": round(hbm_util, 3),
         "hbm_measurement_suspect": bool(hbm_util > 1.0),
@@ -673,8 +779,12 @@ def main() -> None:
             "overlap_compute_bound_vs_ceiling": (
                 ovc.get("achieved_vs_ceiling") if ovc else None
             ),
-            "nbody_gpairs_per_sec": round(nb["gpairs_per_sec"], 3),
-            "nbody_e2e_gpairs": (
+            # two DISTINCT n-body variants (VERDICT r5 #3): sync_per_call
+            # fences every iteration (RTT-bound by construction);
+            # e2e_enqueue_window is the reference-scale 150-iteration run
+            # through enqueue windows (the framework's intended regime)
+            "nbody_sync_per_call_gpairs": round(nb["gpairs_per_sec"], 3),
+            "nbody_e2e_enqueue_gpairs": (
                 nbe.get("gpairs_per_sec") if isinstance(nbe, dict) else None
             ),
             "dtype_cells": (

@@ -196,9 +196,14 @@ class DistributedAccelerator(IComputeNode):
         """Process 0's copy, everywhere (write_all single-owner rule).
 
         An owner-masked byte psum over the process mesh, NOT an N-row
-        all-gather: non-owners contribute exact zeros, so the replicated
-        row-sum IS the owner's payload, and a reduce+broadcast moves
-        O(M) per link where gathering N full copies moves O(N·M)."""
+        all-gather API call: non-owners contribute exact zeros, so the
+        replicated row-sum IS the owner's payload.  INTENT is the
+        reduce+broadcast traffic shape (O(M) per link vs O(N·M) for
+        gathering N full copies), but on a 1-D process mesh XLA may
+        still lower the replicated row-sum as all-gather + local reduce
+        — the per-link byte claim is unverified on this backend (ADVICE
+        r5 #3); what the masked-psum form guarantees is the single-owner
+        SEMANTICS: every process ends with exactly process 0's bytes."""
         import jax
         from jax.sharding import NamedSharding
         from jax.sharding import PartitionSpec as P

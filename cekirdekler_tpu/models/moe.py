@@ -31,6 +31,9 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..parallel.collectives import axis_size
+from ..parallel.mesh import shard_map
+
 __all__ = ["moe_ffn", "moe_ffn_capacity", "moe_ffn_sharded"]
 
 
@@ -81,7 +84,7 @@ def moe_ffn_capacity(x, router, w1, w2, axis: str | None = None,
     dt = x.dtype
     B, T, d = x.shape
     El = w1.shape[0]
-    nshards = lax.axis_size(axis) if axis is not None else 1
+    nshards = axis_size(axis) if axis is not None else 1
     E = El * nshards
     N = B * T
     C = int(max(1, -(-N * capacity_factor // E)))
@@ -144,7 +147,7 @@ def moe_ffn_sharded(mesh: Mesh, x, router, w1, w2, axis: str = "ep",
     else:
         def body(xx, r, a, b):
             return moe_ffn(xx, r, a, b, axis=axis)
-    fn = jax.shard_map(
+    fn = shard_map(
         body,
         mesh=mesh,
         in_specs=(P(), P(), P(axis), P(axis)),

@@ -34,7 +34,9 @@ from .mesh import (
     make_mesh,
     named_sharding,
     replicated,
+    set_mesh,
     shard_batch,
+    shard_map,
 )
 
 __all__ = [
@@ -54,6 +56,8 @@ __all__ = [
     "psum",
     "reduce_scatter",
     "replicated",
+    "set_mesh",
+    "shard_map",
     "ring_attention",
     "ring_attention_sharded",
     "ring_next",

@@ -57,7 +57,16 @@ def axis_index(axis: str):
 
 
 def axis_size(axis: str):
-    return lax.axis_size(axis)
+    """Static size of a bound mesh axis.  lax.axis_size on current jax;
+    on pre-0.6 jax (CPU-only rigs) jax.core.axis_frame(name) already IS
+    the static int size inside shard_map — one compat point for every
+    ring/pipeline/MoE caller that needs a python int (perm tables,
+    capacity math, unrolled schedules)."""
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis)
+    import jax
+
+    return jax.core.axis_frame(axis)  # older jax (0.4.x rigs)
 
 
 def _ring_perm(n: int, shift: int):
@@ -68,7 +77,7 @@ def ppermute_ring(x, axis: str, shift: int = 1):
     """Rotate shards around the ring by ``shift`` positions (the ICI
     replacement for the reference pipeline's host-hop forwardResults,
     SURVEY.md §2.1 #8)."""
-    n = lax.axis_size(axis)
+    n = axis_size(axis)
     return lax.ppermute(x, axis, perm=_ring_perm(n, shift))
 
 

@@ -22,7 +22,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-from .collectives import ppermute_ring
+from .collectives import axis_size, ppermute_ring
+from .mesh import shard_map
 
 __all__ = ["gpipe", "stack_layers"]
 
@@ -50,7 +51,7 @@ def gpipe(
     """
 
     def inner(params_local, xx):
-        S = lax.axis_size(axis)
+        S = axis_size(axis)
         r = lax.axis_index(axis)
         B = xx.shape[0]
         M = n_microbatches
@@ -74,7 +75,7 @@ def gpipe(
         ys = jnp.where(r == S - 1, ys, jnp.zeros_like(ys))
         return lax.psum(ys, axis)
 
-    fn = jax.shard_map(
+    fn = shard_map(
         inner,
         mesh=mesh,
         in_specs=(P(axis), P()),

@@ -1,0 +1,78 @@
+"""bench.py's SectionScheduler: the starvation-proofing contract
+(VERDICT r5 #1 — dtype_matrix/marker_overhead shipped null two rounds
+running because one global budget had no reservations).  Pure host
+logic, driven with a fake clock."""
+
+import bench
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_reserved_sections_run_after_budget_exhausted():
+    clock = _Clock()
+    s = bench.SectionScheduler(100.0, {"dtype_matrix": 30.0}, clock=clock)
+    clock.t = 500.0  # way past budget
+    assert s.run("dtype_matrix", lambda: "ran") == "ran"
+    assert "dtype_matrix" not in s.errors
+
+
+def test_nonreserved_section_skips_when_only_reserve_remains():
+    clock = _Clock()
+    s = bench.SectionScheduler(
+        100.0, {"dtype_matrix": 30.0, "marker_overhead": 10.0}, clock=clock)
+    clock.t = 65.0  # 35s left < 40s reserved -> non-reserved must skip
+    assert s.run("expensive_middle", lambda: "ran", default=None) is None
+    assert "reserved" in s.errors["expensive_middle"]
+    # the reserved sections still run afterwards
+    assert s.run("marker_overhead", lambda: "m") == "m"
+    assert s.run("dtype_matrix", lambda: "d") == "d"
+
+
+def test_nonreserved_section_runs_inside_budget():
+    clock = _Clock()
+    s = bench.SectionScheduler(100.0, {"dtype_matrix": 30.0}, clock=clock)
+    clock.t = 50.0  # 50s left > 30s reserved
+    assert s.run("mid", lambda: 42) == 42
+    assert s.errors == {}
+
+
+def test_critical_sections_always_run():
+    clock = _Clock()
+    s = bench.SectionScheduler(100.0, {"dtype_matrix": 30.0}, clock=clock)
+    clock.t = 500.0
+    assert s.run("framework", lambda: 1, critical=True) == 1
+
+
+def test_section_exception_recorded_not_raised():
+    s = bench.SectionScheduler(100.0, {})
+
+    def boom():
+        raise RuntimeError("tunnel died")
+
+    assert s.run("overlap", boom, default="dflt") == "dflt"
+    assert s.errors["overlap"].startswith("RuntimeError")
+
+
+def test_reserved_sections_registered_in_bench():
+    # the two verdict-ordered sections AND the r6 acceptance-gate metric
+    # must stay must-run
+    assert "dtype_matrix" in bench.RESERVED_SECTIONS
+    assert "marker_overhead" in bench.RESERVED_SECTIONS
+    assert "flash_train" in bench.RESERVED_SECTIONS
+
+
+def test_small_budget_override_still_runs_best_effort_sections():
+    # CK_BENCH_BUDGET_SEC below the reservation sum must not skip
+    # everything from t=0 — reservations cap at 60% of the budget
+    clock = _Clock()
+    s = bench.SectionScheduler(600.0, dict(bench.RESERVED_SECTIONS),
+                               clock=clock)
+    assert s.run("baseline", lambda: "ran") == "ran"
+    clock.t = 500.0  # past the capped 60% window -> best-effort skips
+    assert s.run("overlap", lambda: "ran", default=None) is None

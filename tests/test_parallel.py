@@ -5,7 +5,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax import shard_map
+from cekirdekler_tpu.parallel.mesh import shard_map
 from jax.sharding import PartitionSpec as P
 
 from cekirdekler_tpu import parallel as par

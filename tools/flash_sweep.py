@@ -4,6 +4,13 @@ Separates forward-only and fwd+bwd cost per (T, block_q, block_k) so the
 T=8192 regression can be attributed (fwd kernel? dq kernel? dkv kernel?
 block config?) instead of guessed at.
 
+Round-6: ``default`` rows now exercise the bf16 end-to-end kernels
+(f32 inputs cast once at XLA level, bf16 streamed through fwd+bwd) with
+compact lse/delta operands and causal DMA elision; a third
+``default-bf16io`` variant feeds bf16 inputs directly, isolating the
+kernel from the one-time cast.  MFU per row against the matching
+roofline so block choices compare across precisions.
+
 Methodology (see docs + round-4 notes): the tunnel's dispatch latency is
 ~RTT (today's weather: can exceed 100 ms), so a python loop of jitted
 calls measures the link, not the chip — every rep anomaly (bwd "faster"
@@ -64,26 +71,46 @@ def main(Ts=(4096, 8192), B=1, H=8, D=64):
         print(f"T={T} dense fwd+bwd: {t*1e3:8.2f} ms  "
               f"{flops/t/1e12:6.2f} Tflop/s")
 
+        # MFU denominators: "highest" is true-f32 multi-pass (~peak/6),
+        # the bf16 variants run against the bf16 peak — ONE source of
+        # truth for the rooflines (bench.py), so sweep MFU stays
+        # comparable to the bench artifact's mfu_default
+        from bench import V5E_PEAK_BF16_TFLOPS, V5E_PEAK_F32_TFLOPS
+
+        peaks = {"highest": V5E_PEAK_F32_TFLOPS,
+                 "default": V5E_PEAK_BF16_TFLOPS,
+                 "default-bf16io": V5E_PEAK_BF16_TFLOPS}
+        qb = kb = vb = None
         for (bq, bk) in ((256, 512), (512, 512), (512, 1024), (256, 1024),
-                         (1024, 512), (128, 512)):
-            for prec in ("highest", "default"):
-                fwd = lambda q, k, v, bq=bq, bk=bk, p=prec: flash_attention(
+                         (1024, 512), (1024, 1024), (128, 512)):
+            for prec in ("highest", "default", "default-bf16io"):
+                args, p = (q, k, v), prec
+                if prec == "default-bf16io":
+                    # bf16 operands in HBM: isolates the kernels from the
+                    # per-call f32->bf16 cast the plain default row pays
+                    if qb is None:
+                        qb, kb, vb = (a.astype(jnp.bfloat16)
+                                      for a in (q, k, v))
+                    args, p = (qb, kb, vb), "default"
+                fwd = lambda q, k, v, bq=bq, bk=bk, p=p: flash_attention(
                     q, k, v, True, bq, bk, None, p)
                 g = jax.grad(
-                    lambda q, k, v, bq=bq, bk=bk, p=prec: flash_attention(
-                        q, k, v, True, bq, bk, None, p).sum(),
+                    lambda q, k, v, bq=bq, bk=bk, p=p: flash_attention(
+                        q, k, v, True, bq, bk, None, p)
+                    .astype(jnp.float32).sum(),
                     argnums=(0, 1, 2))
                 try:
-                    tf = bench_loop(fwd, (q, k, v), rtt=rtt)
-                    tg = bench_loop(g, (q, k, v), rtt=rtt)
+                    tf = bench_loop(fwd, args, rtt=rtt)
+                    tg = bench_loop(g, args, rtt=rtt)
                 except Exception as e:
                     print(f"T={T} flash {bq}/{bk} {prec}: FAIL "
                           f"{type(e).__name__}: {e}"[:120])
                     continue
-                print(f"T={T} flash {bq}/{bk} {prec:7s}: "
+                mfu = flops / tg / 1e12 / peaks[prec]
+                print(f"T={T} flash {bq}/{bk} {prec:15s}: "
                       f"fwd {tf*1e3:8.2f} ms ({flops_fwd/tf/1e12:5.2f}) "
                       f"fwd+bwd {tg*1e3:8.2f} ms  "
-                      f"{flops/tg/1e12:6.2f} Tflop/s")
+                      f"{flops/tg/1e12:6.2f} Tflop/s  mfu={mfu:.3f}")
 
 
 if __name__ == "__main__":
