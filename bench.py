@@ -508,10 +508,14 @@ _OVERLAP_KEYS = (
     "rtt_ms", "sample_spread", "heavy_iters",
 )
 
-# same-window ceiling keys (measure_stream_overlap duplex_probe=True)
+# same-window ceiling keys (measure_stream_overlap duplex_probe=True;
+# per-rep model with witness clamp — trace/ceiling.py, VERDICT r5 #4)
 _CEILING_KEYS = (
     "overlap_fraction", "duplex_capacity", "overlap_ceiling",
-    "achieved_vs_ceiling", "compute_transfer_ratio",
+    "achieved_vs_ceiling", "achieved_vs_ceiling_spread",
+    "per_rep_achieved_vs_ceiling", "model_beaten_reps",
+    "negative_overlap_reps", "n_reps",
+    "compute_transfer_ratio",
     "duplex_h2d_ms", "duplex_d2h_ms", "duplex_ms",
 )
 
@@ -650,7 +654,11 @@ def main() -> None:
     # the range balances across 2 partition lanes of the chip (r4 #7).
     from cekirdekler_tpu.workloads import nbody_e2e
 
-    nbe = section("nbody_e2e", lambda: nbody_e2e(devs))
+    # attribution=True (VERDICT r5 #3): the result names each factor of
+    # the e2e-vs-device gap — window RTT, ladder launch, upload/download,
+    # scheduler dispatch, host gap, lane interference — with a
+    # measurement, via the trace subsystem (docs/OBSERVABILITY.md)
+    nbe = section("nbody_e2e", lambda: nbody_e2e(devs, attribution=True))
 
     # Balancer on the 8-device rig with skewed per-range load (r2 #4).
     rig = section("balancer_rig", balancer_rig_section)
@@ -778,6 +786,9 @@ def main() -> None:
             if ovb else None,
             "overlap_compute_bound_vs_ceiling": (
                 ovc.get("achieved_vs_ceiling") if ovc else None
+            ),
+            "overlap_vs_ceiling_spread": (
+                ovc.get("achieved_vs_ceiling_spread") if ovc else None
             ),
             # two DISTINCT n-body variants (VERDICT r5 #3): sync_per_call
             # fences every iteration (RTT-bound by construction);

@@ -19,6 +19,7 @@ from .errors import (
     KernelLanguageError,
 )
 from .hardware import AcceleratorType, Device, Devices, Platform, Platforms, all_devices, platforms
+from . import trace  # span-based attribution (docs/OBSERVABILITY.md)
 
 __version__ = "0.1.0"
 
@@ -41,5 +42,6 @@ __all__ = [
     "TransferFlags",
     "all_devices",
     "platforms",
+    "trace",
     "wrap",
 ]

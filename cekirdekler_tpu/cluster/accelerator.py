@@ -98,7 +98,17 @@ class ClusterAccelerator(IComputeNode):
         ClusterAccelerator.cs:77-155).  ``subnet`` like ``"192.168.1"``;
         None derives it from this host's primary address.  Coordinator
         address lists are the TPU-pod idiom — this exists for the ad-hoc
-        LAN fleets the TCP tier serves."""
+        LAN fleets the TCP tier serves.
+
+        **A /24 netmask is ASSUMED** when ``subnet`` is None (ADVICE r5):
+        the derived prefix is the primary address minus its last octet
+        (``rsplit('.', 1)``), exactly the reference's behavior — no
+        interface netmask is consulted.  On a WIDER subnet (/23, /16…)
+        the 255-host candidate list misses peers outside this /24 slice;
+        on a NARROWER one (/25…) it probes addresses beyond the broadcast
+        domain (harmless: they just time out).  Fleets on non-/24
+        networks should pass ``subnet`` explicitly — one probe call per
+        /24 slice — or full endpoint lists to :meth:`probe`."""
         import socket
 
         if subnet is None:

@@ -44,6 +44,7 @@ from ..arrays.clarray import ClArray, ParameterGroup
 from ..core.cruncher import NumberCruncher
 from ..errors import CekirdeklerError, ComputeValidationError
 from ..hardware import Device, Devices
+from ..trace.spans import TRACER
 from .accelerator import IComputeNode
 from .balancer import ClusterLoadBalancer
 
@@ -106,7 +107,16 @@ def initialize(
     and every cross-process collective silently degenerates."""
     import jax
 
-    if jax.distributed.is_initialized():
+    try:
+        already = jax.distributed.is_initialized()
+    except AttributeError:
+        # pre-0.5 jax has no is_initialized(); the client handle on the
+        # internal global state is the same signal (same convention as
+        # the other pre-0.6 compat shims in parallel/)
+        from jax._src import distributed as _dist
+
+        already = getattr(_dist.global_state, "client", None) is not None
+    if already:
         return  # already joined
     if cpu_collectives:
         try:
@@ -179,6 +189,7 @@ class DistributedAccelerator(IComputeNode):
         from jax.sharding import NamedSharding
         from jax.sharding import PartitionSpec as P
 
+        _tt = TRACER.t0()
         value = np.ascontiguousarray(value)
         raw = value.view(np.uint8)
         mesh = _process_mesh()
@@ -189,6 +200,9 @@ class DistributedAccelerator(IComputeNode):
             (nproc,) + raw.shape, NamedSharding(mesh, P("x")), [shard]
         )
         gathered = np.asarray(_replicator(mesh)(garr))
+        TRACER.record(
+            "dcn-exchange", _tt, tag=f"allgather {raw.nbytes}B x{nproc}"
+        )
         return gathered.view(value.dtype).reshape((nproc,) + value.shape)
 
     @staticmethod
@@ -208,6 +222,7 @@ class DistributedAccelerator(IComputeNode):
         from jax.sharding import NamedSharding
         from jax.sharding import PartitionSpec as P
 
+        _tt = TRACER.t0()
         value = np.ascontiguousarray(value)
         raw = value.view(np.uint8)
         mesh = _process_mesh()
@@ -218,6 +233,9 @@ class DistributedAccelerator(IComputeNode):
             (nproc,) + raw.shape, NamedSharding(mesh, P("x")), [shard]
         )
         out = np.asarray(_reducer(mesh)(garr))
+        TRACER.record(
+            "dcn-exchange", _tt, tag=f"broadcast0 {raw.nbytes}B"
+        )
         return out.view(value.dtype).reshape(value.shape)
 
     def barrier(self, tag: str = "ck_dcn_barrier") -> None:
@@ -286,6 +304,7 @@ class DistributedAccelerator(IComputeNode):
 
         my_share = shares[self.pid]
         my_off = int(refs[self.pid])
+        _tt = TRACER.t0()
         t0 = time.perf_counter()
         if my_share > 0:
             group = ParameterGroup(params)
@@ -326,6 +345,10 @@ class DistributedAccelerator(IComputeNode):
 
         times = self._allgather(np.asarray([wall_ms], np.float64))
         self.timings[compute_id] = [float(t) for t in times.reshape(-1)]
+        TRACER.record(
+            "enqueue", _tt, cid=compute_id,
+            tag=f"dcn p{self.pid}/{self.nproc} share{my_share}",
+        )
 
     def compute_timing(self, compute_id: int) -> list[float]:
         return list(self.timings.get(compute_id, []))

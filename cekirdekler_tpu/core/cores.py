@@ -36,6 +36,8 @@ from ..arrays.clarray import ClArray
 from ..errors import ComputeValidationError
 from ..hardware import Devices
 from ..kernel.registry import KernelProgram
+from ..trace.attribution import split_fence_benches
+from ..trace.spans import TRACER
 from .balance import BalanceHistory, BalanceState, equal_split, load_balance
 from .worker import Worker
 
@@ -111,6 +113,17 @@ class Cores:
         self._enqueue_cids: set[int] = set()
         self._enqueue_t0: float | None = None
         self._enqueue_rebalance: set[int] = set()
+        # per-cid fence splitting (VERDICT r5 #8): when on, barrier()
+        # fences each compute id's last output in last-dispatch order and
+        # feeds the balancer MARGINAL per-cid times instead of charging
+        # the whole-window fence time to every id dispatched in a mixed
+        # window (trace/attribution.split_fence_benches).  Off by
+        # default: the split costs one extra ~RTT probe per cid in the
+        # window (plus workers pinning the probe buffers), and
+        # homogeneous windows (one kernel per window) are measured
+        # exactly either way.
+        self._fence_split = False
+        self._enqueue_cid_order: list[int] = []
         # host-gated dispatch (reference: ClUserEvent bound to queues +
         # Worker.cs:487-557 synchronized start): when set, every worker
         # lane blocks on the event before its compute phase, so triggering
@@ -142,6 +155,25 @@ class Cores:
             self.histories.clear()
             self._balance_states.clear()
             self._cont_ranges.clear()
+
+    @property
+    def fence_split(self) -> bool:
+        return self._fence_split
+
+    @fence_split.setter
+    def fence_split(self, v: bool) -> None:
+        v = bool(v)
+        self._fence_split = v
+        for w in self.workers:
+            # workers record per-cid completion-probe buffers only while
+            # the split can consume them — each record pins a device
+            # buffer, a cost computes with the flag off must not pay;
+            # turning OFF also releases the already-pinned probes (with
+            # the flag off nothing can ever read them again)
+            w.track_cid_outputs = v
+            if not v:
+                with w.lock:
+                    w._cid_last_out.clear()
 
     @property
     def num_devices(self) -> int:
@@ -240,10 +272,32 @@ class Cores:
         # event benches — ours does at sync granularity).  Residency stays
         # correct across a move because workers skip re-uploads only for
         # covered ranges (Worker.upload_covers).
+        #
+        # KNOWN LIMIT (present since the seed, surfaced by the r7 trace
+        # hammer): enqueue windows must be driven by ONE host thread.
+        # With several threads enqueuing different cids while one
+        # barriers, an armed rebalance's flush+reset_coverage can
+        # interleave with another thread's in-flight window — that
+        # thread's next covered-range check then re-uploads a host copy
+        # missing its own post-flush device increments (lost updates,
+        # measured 10-12/12 arrays on the 2-lane rig at seed, with or
+        # without fence_split).  The concurrent-thread contract
+        # (Worker.lock) covers the NON-enqueue path; fixing the enqueue
+        # variant needs window-scoped coverage epochs — future PR.
         if self.enqueue_mode:
             if self._enqueue_t0 is None:
                 self._enqueue_t0 = t_start
-            self._enqueue_cids.add(compute_id)
+            # under the lock: concurrent host threads may drive different
+            # compute ids through one Cores, and the order list's
+            # remove+append is not atomic like the set add is
+            with self._lock:
+                if compute_id in self._enqueue_cids:
+                    # keep the order list in LAST-dispatch order — the
+                    # fence split probes completions ascending, and a
+                    # cid's last launch is what its probe waits on
+                    self._enqueue_cid_order.remove(compute_id)
+                self._enqueue_cid_order.append(compute_id)
+                self._enqueue_cids.add(compute_id)
         old_ranges = list(self.global_ranges.get(compute_id, ()))
         ranges, refs = self._ranges_for(
             compute_id,
@@ -253,6 +307,11 @@ class Cores:
             or compute_id in self._enqueue_rebalance,
         )
         self._enqueue_rebalance.discard(compute_id)
+        if ranges != old_ranges:
+            TRACER.instant(
+                "split" if not old_ranges else "rebalance",
+                cid=compute_id, tag=str(ranges),
+            )
         if self.enqueue_mode and old_ranges and ranges != old_ranges:
             # the balancer moved shares between syncs: host arrays must be
             # made current BEFORE any chip uploads its newly-acquired region
@@ -319,6 +378,10 @@ class Cores:
         if errs:
             raise errs[0]
 
+        TRACER.record(
+            "enqueue", t_start, cid=compute_id,
+            tag="+".join(kernel_names),
+        )
         perf = ComputePerf(
             compute_id=compute_id,
             device_ms=[w.benchmarks.get(compute_id, 0.0) for w in self.workers],
@@ -414,6 +477,7 @@ class Cores:
                     self.program, kernel_names, params, value_args,
                     offset, size, local_range, global_range, local_range,
                     repeats=self.repeat_count, sync_kernel=self.repeat_sync_kernel,
+                    compute_id=compute_id,
                 )
             t_dispatched = time.perf_counter() if self.trace_lanes else 0.0
             # D2H
@@ -534,6 +598,7 @@ class Cores:
         R+C+W with no events, Cores.cs:1371-1858).  XLA's async dispatch
         streams play the role of the 16 in-order queues: the transfer
         engine runs blob k+1's DMA while the compute stream runs blob k."""
+        _tt = TRACER.t0()
         blob = size // blobs
         if blob <= 0:
             blob, blobs = size, 1
@@ -553,6 +618,7 @@ class Cores:
                     self.program, kernel_names, params, value_args,
                     boff, blob, local_range, global_range, local_range,
                     repeats=self.repeat_count, sync_kernel=self.repeat_sync_kernel,
+                    compute_id=compute_id,
                 )
             for idx, p in enumerate(params):
                 fl = p.flags
@@ -562,6 +628,10 @@ class Cores:
                     epw = fl.elements_per_work_item
                     handles.append(w.download_async(p, boff * epw, blob * epw, False))
         self._pipeline_epilogue(w, params, offset, size, write_all_owner, handles)
+        TRACER.record(
+            "pipeline-stage", _tt, cid=compute_id, lane=w.index,
+            tag=f"DRIVER x{blobs}",
+        )
 
     def _run_pipelined_event(
         self,
@@ -592,6 +662,7 @@ class Cores:
         single blob's transfer outlasts one compute step (the r3 overlap
         shortfall), at the cost of up to L+1 simultaneously staged blobs
         of host/HBM footprint (blob j is staged before blob j-L pops)."""
+        _tt = TRACER.t0()
         blob = size // blobs
         if blob <= 0:
             blob, blobs = size, 1
@@ -633,6 +704,7 @@ class Cores:
                         offset + k * blob, blob, local_range, global_range,
                         local_range, repeats=self.repeat_count,
                         sync_kernel=self.repeat_sync_kernel,
+                        compute_id=compute_id,
                     )
             m = j - look - 1
             if 0 <= m < blobs and not self.enqueue_mode:  # write stage
@@ -641,6 +713,10 @@ class Cores:
                     epw = p.flags.elements_per_work_item
                     handles.append(w.download_async(p, boff * epw, blob * epw, False))
         self._pipeline_epilogue(w, params, offset, size, write_all_owner, handles)
+        TRACER.record(
+            "pipeline-stage", _tt, cid=compute_id, lane=w.index,
+            tag=f"EVENT x{blobs} look{look}",
+        )
 
     # -- enqueue-mode sync (reference: flushLastUsedCommandQueue / finish) ----
     def flush(self) -> None:
@@ -711,26 +787,49 @@ class Cores:
         call (sync-granularity analogue of the reference feeding event
         benches into loadBalance, HelperFunctions.cs:190-280).
 
-        Heuristic caveat: the whole-window fence time is assigned as the
-        bench of EVERY compute id dispatched in the window.  When kernels
-        with different per-chip cost profiles share one enqueue window,
-        each id's bench includes the others' work, so a subsequent armed
-        rebalance can misattribute cost between them.  Ids dispatched in
-        homogeneous windows (one kernel per window — the common pattern)
-        are measured exactly; mixed windows trade per-id attribution for
-        the single-RTT sync.  Callers that need exact per-id benches
-        should barrier between different kernels' dispatch runs."""
+        Mixed-window attribution: by default the whole-window fence time
+        is assigned as the bench of EVERY compute id dispatched in the
+        window — when kernels with different per-chip cost profiles
+        share one enqueue window, each id's bench includes the others'
+        work and a subsequent armed rebalance can misattribute cost
+        between them.  Ids dispatched in homogeneous windows (one kernel
+        per window — the common pattern) are measured exactly either
+        way.  With :attr:`fence_split` on, the barrier instead fences
+        each compute id's LAST launch output in last-dispatch order and
+        feeds the balancer MARGINAL per-cid times
+        (trace/attribution.split_fence_benches): batched mixed windows
+        (all of id A, then all of id B) are then measured exactly per
+        id, at the cost of one extra ~RTT completion probe per id in
+        the window; interleaved windows remain bounded by stream order
+        (a cid's marginal includes earlier-dispatched work of
+        later-completing ids)."""
+        t_b = TRACER.t0()
         t0 = self._enqueue_t0
         measure = self.enqueue_mode and t0 is not None and len(self.workers) > 1
+        split_order = (
+            list(self._enqueue_cid_order)
+            if (self.fence_split and measure and len(self._enqueue_cids) > 1)
+            else []
+        )
         try:
             if len(self.workers) == 1:
                 self.workers[0].fence()
+                TRACER.record("fence", t_b, tag="barrier")
                 return
             done_at: dict[int, float] = {}
+            comp_at: dict[int, list[tuple[int, float]]] = {}
 
             def fence_timed(w: Worker) -> None:
+                comps: list[tuple[int, float]] = []
+                for cid in split_order:
+                    rng = self.global_ranges.get(cid)
+                    if rng is not None and rng[w.index] <= 0:
+                        continue  # this chip never ran the id
+                    if w.fence_cid(cid):
+                        comps.append((cid, time.perf_counter()))
                 w.fence()
                 done_at[w.index] = time.perf_counter()
+                comp_at[w.index] = comps
 
             errs: list[Exception] = []
             futs = [self.pool.submit(fence_timed, w) for w in self.workers]
@@ -744,19 +843,28 @@ class Cores:
             if measure:
                 for w in self.workers:
                     bench = (done_at[w.index] - t0) * 1000.0
+                    splits = split_fence_benches(comp_at.get(w.index, ()), t0)
                     for cid in self._enqueue_cids:
-                        # only chips that ran this id refresh its bench
+                        # only chips that ran this id refresh its bench;
+                        # split marginals when available, whole-window
+                        # fence time otherwise (the documented default)
                         if self.global_ranges.get(cid, [1] * len(self.workers))[w.index] > 0:
-                            w.benchmarks[cid] = bench
+                            w.benchmarks[cid] = splits.get(cid, bench)
                 self._enqueue_rebalance |= self._enqueue_cids
+            TRACER.record("fence", t_b, tag="barrier")
         finally:
             # always close the window — a fence failure must not leave a
             # stale t0/cid set to corrupt the NEXT window's benches
             self._enqueue_window_closed()
 
     def _enqueue_window_closed(self) -> None:
-        self._enqueue_cids.clear()
-        self._enqueue_t0 = None
+        # under the lock: compute() holds it across its check+remove on
+        # the order list — an unlocked clear here could interleave
+        # between those two steps and turn the remove into a ValueError
+        with self._lock:
+            self._enqueue_cids.clear()
+            self._enqueue_cid_order.clear()
+            self._enqueue_t0 = None
 
     def ranges_of(self, compute_id: int) -> list[int]:
         return list(self.global_ranges.get(compute_id, []))

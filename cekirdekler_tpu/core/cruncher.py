@@ -118,6 +118,19 @@ class NumberCruncher:
         self.cores.performance_feed = bool(v)
 
     @property
+    def fence_split(self) -> bool:
+        """Per-compute-id fence splitting at enqueue-mode barriers
+        (VERDICT r5 #8): marginal per-cid benches from completion-order
+        probes instead of one whole-window fence time charged to every
+        id in a mixed window.  Costs ~1 extra RTT probe per id per
+        barrier; off by default."""
+        return self.cores.fence_split
+
+    @fence_split.setter
+    def fence_split(self, v: bool) -> None:
+        self.cores.fence_split = bool(v)
+
+    @property
     def smooth_load_balancer(self) -> bool:
         return self.cores.smooth_load_balancer
 

@@ -33,6 +33,7 @@ from jax import lax
 
 from ..arrays.clarray import ClArray
 from ..kernel.registry import KernelProgram
+from ..trace.spans import TRACER
 from ..utils.markers import MarkerCounter
 
 __all__ = ["Worker"]
@@ -118,6 +119,15 @@ class Worker:
         # fine-grained progress markers (reference: queue markers,
         # ClCommandQueue.cs:99-115); None unless enabled by the cruncher
         self.markers: MarkerCounter | None = None
+        # per-compute-id LAST output value of the most recent launch —
+        # materializing it retires exactly when that cid's final kernel
+        # retires (stream order), which is what the per-cid fence split
+        # probes (trace/attribution.py split_fence_benches).  Recorded
+        # only while track_cid_outputs is set (Cores.fence_split
+        # propagates it): each record pins a device buffer until the cid
+        # cycles out, a cost only the split should pay.
+        self.track_cid_outputs = False
+        self._cid_last_out: dict[int, Any] = {}
 
     # -- benchmarks ----------------------------------------------------------
     def start_bench(self, compute_id: int) -> None:
@@ -189,6 +199,7 @@ class Worker:
     def upload(self, arr: ClArray, offset_elems: int, size_elems: int, full: bool) -> None:
         """H2D: full array or only this chip's range slice (reference:
         writeToBuffer / writeToBufferRanged, Worker.cs:821-885)."""
+        _tt = TRACER.t0()
         key = id(arr)
         host = arr.host()
         if full:
@@ -199,6 +210,7 @@ class Worker:
             if self.markers is not None:
                 self.markers.add()
                 self.markers.reach_when_ready(buf)
+            TRACER.record("upload", _tt, lane=self.index, tag=arr.name)
             return
         buf = self._buffer_for(arr)
         if self.markers is not None:
@@ -209,6 +221,7 @@ class Worker:
         self._record_upload(arr, offset_elems, size_elems)
         if self.markers is not None:
             self.markers.reach_when_ready(out)
+        TRACER.record("upload", _tt, lane=self.index, tag=arr.name)
 
     def stage_upload(self, arr: ClArray, offset_elems: int, size_elems: int):
         """Start the H2D DMA for a range slice WITHOUT inserting it into the
@@ -216,12 +229,14 @@ class Worker:
         transfer while blob j computes (reference: the read queue of the
         3-queue event pipeline, Cores.cs:1263-1295).  Returns a handle for
         :meth:`commit_upload`."""
+        _tt = TRACER.t0()
         host = arr.host()
         if self.markers is not None:
             self.markers.add()
         sl = self._h2d(host[offset_elems : offset_elems + size_elems], arr.flags.zero_copy)
         if self.markers is not None:
             self.markers.reach_when_ready(sl)
+        TRACER.record("upload", _tt, lane=self.index, tag=f"stage:{arr.name}")
         return (arr, sl, offset_elems)
 
     def commit_upload(self, staged) -> None:
@@ -271,12 +286,16 @@ class Worker:
         step: int,
         repeats: int = 1,
         sync_kernel: str | None = None,
+        compute_id: int | None = None,
     ) -> None:
         """Run the kernel sequence over work items [offset, offset+size) on
         this chip.  ``repeats`` reruns the sequence on-device without host
         round-trips (reference: computeRepeated / repeatCount,
         Worker.cs:1051-1069); ``sync_kernel`` interleaves a synchronization
-        kernel between repeats (computeRepeatedWithSyncKernel)."""
+        kernel between repeats (computeRepeatedWithSyncKernel).
+        ``compute_id`` tags the launch span and the per-cid completion
+        probe used by the fence split — optional, purely observability."""
+        _tt = TRACER.t0()
         bufs = tuple(self._buffers[id(p)] for p in params)
         names = list(kernel_names)
         dispatched = 0
@@ -322,6 +341,23 @@ class Worker:
                         offset -= size  # rewind for next kernel/repeat
         for p, b in zip(params, bufs):
             self._buffers[id(p)] = b
+        if bufs:
+            if compute_id is not None and self.track_cid_outputs:
+                # last output value of this cid's latest launch: the
+                # fence-split completion probe (stream order means
+                # materializing it waits for exactly this work).
+                # Re-insert to refresh recency, bound to the 64 most
+                # recent cids (the perf_log convention) — unbounded, a
+                # fresh-cid-per-job caller would pin one stale device
+                # buffer per cid forever
+                self._cid_last_out.pop(compute_id, None)
+                self._cid_last_out[compute_id] = bufs[0]
+                if len(self._cid_last_out) > 64:
+                    self._cid_last_out.pop(next(iter(self._cid_last_out)))
+            TRACER.record(
+                "launch", _tt, cid=compute_id, lane=self.index,
+                tag=f"{'+'.join(names)} x{dispatched}",
+            )
         if self.markers is not None and bufs:
             # one marker per actual dispatch, reached when the sequence's
             # final output retires on the chip (real in-flight depth, not
@@ -346,11 +382,12 @@ class Worker:
             out.copy_to_host_async()
         except Exception:
             pass
-        return (arr, out, off, self.markers)
+        return (arr, out, off, self.markers, self.index)
 
     @staticmethod
     def finish_download(handle) -> None:
-        arr, out, off, markers = handle
+        arr, out, off, markers, lane = handle
+        _tt = TRACER.t0()
         host = arr.host()
         data = np.asarray(out)
         view = host[off : off + data.size]
@@ -373,6 +410,7 @@ class Worker:
             )
         else:
             view[:] = data
+        TRACER.record("download", _tt, lane=lane, tag=arr.name)
         if markers is not None:
             markers.reach()
 
@@ -383,17 +421,41 @@ class Worker:
         O(1) round trips per chip, not O(buffers).  On tunneled backends
         ``block_until_ready`` can return before remote execution finishes,
         so the host-materialized probe is the reliable fence."""
+        # no span here: fence() is (almost) always driven by
+        # Cores.barrier, whose own "fence" span covers the wait — a
+        # second nested same-kind span would double-count fence time in
+        # every per-kind total (the per-cid completion probes, fence_cid,
+        # do record: they carry information the barrier span does not)
         with self.lock:
             bufs = [b for b in self._buffers.values() if b.size]
         if not bufs:
             return
         np.asarray(_fence_probe(bufs))
 
+    def fence_cid(self, compute_id: int) -> bool:
+        """Block until this chip's work for ONE compute id has retired:
+        materialize 1 element of the cid's last launch output.  Stream
+        order means this returns exactly when that cid's final kernel
+        (and everything dispatched before it) completed — the per-cid
+        completion probe behind the fence split (Cores.barrier with
+        ``fence_split`` on).  Returns False when the cid never launched
+        here (zero share)."""
+        buf = self._cid_last_out.get(compute_id)
+        if buf is None:
+            return False
+        _tt = TRACER.t0()
+        np.asarray(buf[:1])
+        TRACER.record(
+            "fence", _tt, cid=compute_id, lane=self.index, tag="cid-split"
+        )
+        return True
+
     def dispose(self) -> None:
         self._buffers.clear()
         self._buffer_owner.clear()
         self._uploaded.clear()
         self.benchmarks.clear()
+        self._cid_last_out.clear()
         if self.markers is not None:
             self.markers.close()
             self.markers = None

@@ -36,6 +36,7 @@ from ..arrays.clarray import ClArray, wrap
 from ..errors import CekirdeklerError, ComputeValidationError
 from ..hardware import Device
 from ..kernel.registry import KernelProgram
+from ..trace.spans import TRACER
 
 __all__ = ["PipelineStage", "ClPipeline", "DevicePipeline", "ArrayRole"]
 
@@ -166,6 +167,7 @@ class PipelineStage:
         if self._cores is not None:
             self._run_multi(kernel_names)
             return
+        _tt = TRACER.t0()
         t0 = time.perf_counter()
         slots = self._slots()
         # placement ownership: every producer of a single-chip stage's slot
@@ -192,6 +194,11 @@ class PipelineStage:
         for s, b in zip(slots, bufs):
             s.value = b
         self.elapsed_ms = (time.perf_counter() - t0) * 1000.0
+        TRACER.record(
+            "pipeline-stage", _tt,
+            tag=f"{self.device.name if self.device else '?'}:"
+                f"{'+'.join(kernel_names)}",
+        )
 
     def _run_multi(self, kernel_names: list[str]) -> None:
         """Multi-chip stage body: pull incoming device values to host, run
@@ -202,6 +209,7 @@ class PipelineStage:
         through host arrays, ClPipeline.cs:287-603,624-1580)."""
         import time
 
+        _tt = TRACER.t0()
         t0 = time.perf_counter()
         slots = self._slots()
         for s in slots:
@@ -219,6 +227,11 @@ class PipelineStage:
         for s in self.outputs + self.transitions:
             s.value = s.arr.host()
         self.elapsed_ms = (time.perf_counter() - t0) * 1000.0
+        TRACER.record(
+            "pipeline-stage", _tt,
+            tag=f"multi[{len(self.devices) if self.devices else 0}]:"
+                f"{'+'.join(kernel_names)}",
+        )
 
 
 class ClPipeline:

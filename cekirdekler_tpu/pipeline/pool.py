@@ -37,6 +37,7 @@ from ..arrays.clarray import ClArray, ParameterGroup
 from ..core.cruncher import NumberCruncher
 from ..errors import CekirdeklerError
 from ..hardware import Device, Devices
+from ..trace.spans import TRACER
 
 __all__ = ["ClTaskType", "ClTask", "ClTaskPool", "ClDevicePool", "PoolType"]
 
@@ -205,7 +206,12 @@ class _Consumer(threading.Thread):
             for task in batch:
                 try:
                     self._throttle()
+                    _tt = TRACER.t0()
                     task.compute(self.cruncher)
+                    TRACER.record(
+                        "pool-task", _tt, cid=task.compute_id,
+                        lane=self.index, tag=f"task{task.task_id}",
+                    )
                     self.tasks_done += 1
                     if task.callback is not None:
                         task.callback(task)

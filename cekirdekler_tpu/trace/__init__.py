@@ -1,0 +1,51 @@
+"""``cekirdekler_tpu.trace`` — span-based attribution: explain every
+lost millisecond.
+
+Four pieces (see ``docs/OBSERVABILITY.md`` for the guided tour):
+
+- :mod:`.spans` — the process-global :data:`TRACER`: a lock-free-ish
+  ring buffer of typed spans (enqueue, split, rebalance, launch, fence,
+  upload, download, pipeline-stage, pool-task, dcn-exchange) recorded by
+  every runtime layer; a no-op when disabled (<1 µs/span, pinned by
+  test).
+- :mod:`.attribution` — per-window "where did the time go" reports
+  reconciling host wall time against span totals and device-busy time,
+  plus the per-compute-id fence split that fixes the one-fence-time-
+  for-all-cids balancer distortion.
+- :mod:`.export` — Chrome-trace (``chrome://tracing`` / Perfetto) JSON
+  export and the plain-text table.
+- :mod:`.ceiling` — the overlap ceiling re-derived from same-rep duplex
+  probes with a witness clamp, so ``achieved_vs_ceiling`` is a real
+  ratio-to-a-bound (≤ 1 structurally) with per-rep spread.
+
+None of these import jax at module level: enabling tracing costs no
+backend initialization.
+"""
+
+from .attribution import AttributionReport, split_fence_benches, window_report
+from .ceiling import RepSample, ceiling_report, rep_ceiling
+from .export import (
+    from_chrome_trace,
+    save_chrome_trace,
+    text_table,
+    to_chrome_trace,
+)
+from .spans import SPAN_KINDS, TRACER, Span, Tracer, tracing
+
+__all__ = [
+    "AttributionReport",
+    "RepSample",
+    "SPAN_KINDS",
+    "Span",
+    "TRACER",
+    "Tracer",
+    "ceiling_report",
+    "from_chrome_trace",
+    "rep_ceiling",
+    "save_chrome_trace",
+    "split_fence_benches",
+    "text_table",
+    "to_chrome_trace",
+    "tracing",
+    "window_report",
+]

@@ -1,0 +1,234 @@
+"""Typed span recording: the host-side half of the attribution subsystem.
+
+The reference's only observability is host-side stopwatches scattered
+through the runtime (SURVEY §5.1; Worker.cs:753-807, Cores.cs:994-1063)
+and its planned timeline-overlap query is a ``NotImplementedException``
+(ClPipeline.cs:2391-2399).  This module replaces ad-hoc stopwatches with
+ONE process-global :class:`Tracer`: every runtime layer (worker phases,
+both cores pipeline engines, device pipelines, pools, the DCN tier)
+records typed :class:`Span` records into a fixed-capacity ring buffer,
+tagged with compute id and lane, so a lost millisecond anywhere in the
+stack has a name.
+
+Design constraints, in order:
+
+1. **Disabled is free.**  The tracer ships enabled on no hot path by
+   default; instrumentation sites pay two attribute reads and a falsy
+   check (<1 µs per would-be span, measured by
+   ``tests/test_trace.py::test_disabled_tracer_overhead``).  The
+   convention at hot sites is the ``t0()``/``record()`` pair::
+
+       t0 = TRACER.t0()          # 0.0 when disabled — no clock read
+       ...work...
+       TRACER.record("launch", t0, cid=cid, lane=self.index)
+
+2. **Lock-free-ish.**  Recording is one ``itertools.count`` increment
+   (atomic under the GIL) plus one list-slot store — concurrent worker
+   threads never contend on a lock to record.  The ring overwrites the
+   oldest spans when full; ``total_recorded`` keeps the true count so a
+   wrapped buffer is detectable, never silent.
+
+3. **Monotonic clocks.**  All timestamps are ``time.perf_counter()``
+   seconds, comparable across threads within the process (the exchange
+   rate to device-side Xprof events is handled by
+   ``trace/attribution.py``, which reconciles totals, not timestamps).
+
+Span kinds used by the built-in instrumentation (callers may add more):
+``enqueue`` (a compute() dispatch), ``split`` (first range table),
+``rebalance`` (the balancer moved shares), ``launch`` (kernel dispatch),
+``fence`` (retirement wait), ``upload`` (H2D), ``download`` (D2H),
+``pipeline-stage`` (one pipeline engine/stage body), ``pool-task``
+(device-pool task), ``dcn-exchange`` (cross-host collective).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from contextlib import contextmanager
+from typing import Iterable, NamedTuple
+
+__all__ = ["Span", "Tracer", "TRACER", "SPAN_KINDS", "tracing"]
+
+SPAN_KINDS = (
+    "enqueue", "split", "rebalance", "launch", "fence",
+    "upload", "download", "pipeline-stage", "pool-task", "dcn-exchange",
+)
+
+
+class Span(NamedTuple):
+    """One timed event.  ``t0``/``t1`` are perf_counter seconds; ``cid``
+    is the compute id (None where no compute id applies), ``lane`` the
+    worker/consumer index, ``tag`` a short free-form annotation."""
+
+    kind: str
+    t0: float
+    t1: float
+    cid: int | None = None
+    lane: int | None = None
+    tag: str | None = None
+
+    @property
+    def dur_ms(self) -> float:
+        return (self.t1 - self.t0) * 1000.0
+
+
+class Tracer:
+    """Process-global span recorder (one instance: :data:`TRACER`).
+
+    ``enabled`` is a plain attribute on purpose: the disabled fast path
+    must be an attribute read, not a property call."""
+
+    def __init__(self, capacity: int = 65536):
+        self.enabled = False
+        self._cap = max(16, int(capacity))
+        self._buf: list[Span | None] = [None] * self._cap
+        self._count = itertools.count()
+        self._total = 0
+        self._lock = threading.Lock()  # enable/clear only — never record()
+
+    # -- recording (hot path) ------------------------------------------------
+    def t0(self) -> float:
+        """Span-open timestamp, or 0.0 when disabled (no clock read)."""
+        return time.perf_counter() if self.enabled else 0.0
+
+    def record(
+        self,
+        kind: str,
+        t0: float,
+        cid: int | None = None,
+        lane: int | None = None,
+        tag: str | None = None,
+        t1: float | None = None,
+    ) -> None:
+        """Close and store a span opened at ``t0``.  No-op when disabled
+        or when ``t0`` is the disabled sentinel (0.0) — a site that
+        opened its span while the tracer was off records nothing even if
+        the tracer was enabled mid-span."""
+        if not self.enabled or not t0:
+            return
+        i = next(self._count)  # GIL-atomic slot claim — no lock
+        buf = self._buf
+        # index by the captured buffer's OWN length, not self._cap: a
+        # concurrent enable(capacity=...) swaps buffer and cap in two
+        # steps, and mixing one thread's buffer with the other's modulus
+        # would IndexError inside instrumented real work
+        buf[i % len(buf)] = Span(
+            kind, t0, t1 if t1 is not None else time.perf_counter(),
+            cid, lane, tag,
+        )
+        self._total = i + 1  # approximate under races; reporting only
+
+    def instant(
+        self,
+        kind: str,
+        cid: int | None = None,
+        lane: int | None = None,
+        tag: str | None = None,
+    ) -> None:
+        """Zero-duration marker (e.g. a rebalance decision)."""
+        if not self.enabled:
+            return
+        t = time.perf_counter()
+        self.record(kind, t, cid=cid, lane=lane, tag=tag, t1=t)
+
+    @contextmanager
+    def span(
+        self,
+        kind: str,
+        cid: int | None = None,
+        lane: int | None = None,
+        tag: str | None = None,
+    ):
+        """Context-manager convenience for non-hot sites; records even
+        when the body raises (the failing span is usually the one you
+        want to see)."""
+        t0 = self.t0()
+        try:
+            yield
+        finally:
+            self.record(kind, t0, cid=cid, lane=lane, tag=tag)
+
+    # -- control -------------------------------------------------------------
+    def enable(self, capacity: int | None = None, clear: bool = True) -> None:
+        with self._lock:
+            if capacity is not None and capacity != self._cap:
+                # resizing rebuilds the ring; with clear=False the newest
+                # existing spans migrate so keep=True keeps its promise,
+                # and the counters restart so total_recorded/ring-wrap
+                # reporting describes the NEW buffer, not the old one
+                keep = [] if clear else self.snapshot()
+                self._cap = max(16, int(capacity))
+                self._clear_locked()
+                for s in keep[-self._cap:]:
+                    i = next(self._count)
+                    self._buf[i % self._cap] = s
+                    self._total = i + 1
+            elif clear:
+                self._clear_locked()
+            self.enabled = True
+
+    def disable(self) -> None:
+        self.enabled = False
+
+    def clear(self) -> None:
+        with self._lock:
+            self._clear_locked()
+
+    def _clear_locked(self) -> None:
+        self._buf = [None] * self._cap
+        self._count = itertools.count()
+        self._total = 0
+
+    # -- inspection ----------------------------------------------------------
+    @property
+    def total_recorded(self) -> int:
+        """Spans recorded since the last clear — exceeds ``capacity``
+        when the ring wrapped (older spans were overwritten)."""
+        return self._total
+
+    @property
+    def capacity(self) -> int:
+        return self._cap
+
+    def snapshot(self) -> list[Span]:
+        """Recorded spans, oldest first.  Concurrent recording during
+        the snapshot may drop/duplicate a span at the wrap edge — the
+        snapshot is for reporting, not for synchronization."""
+        buf = list(self._buf)  # one slice: consistent-enough view
+        spans = [s for s in buf if s is not None]
+        spans.sort(key=lambda s: s.t0)
+        return spans
+
+    def spans_between(self, t_lo: float, t_hi: float) -> list[Span]:
+        """Spans that overlap the window [t_lo, t_hi]."""
+        return [s for s in self.snapshot() if s.t1 >= t_lo and s.t0 <= t_hi]
+
+
+#: The process-global tracer every built-in instrumentation site uses.
+TRACER = Tracer()
+
+
+@contextmanager
+def tracing(capacity: int | None = None, keep: bool = False):
+    """Scoped enable of the global tracer::
+
+        with trace.tracing() as tr:
+            ...instrumented work...
+        report = attribution.window_report(tr.snapshot(), t0, t1)
+
+    Disables on exit; spans survive (``keep`` preserves pre-existing
+    spans instead of clearing on entry)."""
+    TRACER.enable(capacity=capacity, clear=not keep)
+    try:
+        yield TRACER
+    finally:
+        TRACER.disable()
+
+
+def spans_by_kind(spans: Iterable[Span]) -> dict[str, list[Span]]:
+    out: dict[str, list[Span]] = {}
+    for s in spans:
+        out.setdefault(s.kind, []).append(s)
+    return out

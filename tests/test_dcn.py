@@ -1,16 +1,27 @@
-"""DCN multi-host tier (cluster/dcn.py): 2 real processes × 4 virtual CPU
-devices each, joined through a jax.distributed coordinator, computing one
+"""DCN multi-host tier (cluster/dcn.py): real processes x virtual CPU
+devices joined through a jax.distributed coordinator, computing one
 balanced global range with results exchanged over XLA collectives
 (SURVEY.md §7 step 6; VERDICT r4 next-round #4).
 
-The in-job assertions (correctness, share agreement, balancer movement)
-live in tests/_dcn_worker.py — this file owns process lifecycle only.
+Two jobs:
+- symmetric 2 processes x 4 devices (the original parity proof);
+- ASYMMETRIC 3 processes x (4, 2, 2) devices (VERDICT r5 #6): the
+  configuration `_allgather`'s design argument rests on — per-process
+  steps differ, the LCM-step table must reflect them, and shares must
+  snap to each process's own step.  Skip-guarded for constrained CI via
+  ``CK_SKIP_DCN_ASYM=1``.
+
+The in-job assertions (correctness, share agreement, LCM-step table,
+balancer movement) live in tests/_dcn_worker.py — this file owns process
+lifecycle only.
 """
 
 import os
 import socket
 import subprocess
 import sys
+
+import pytest
 
 
 def _free_port() -> int:
@@ -30,15 +41,17 @@ def _worker_env(n_devices: int) -> dict:
     return env
 
 
-def test_two_process_distributed_compute():
+def _run_job(counts: list[int], timeout: float = 240.0) -> None:
     here = os.path.dirname(os.path.abspath(__file__))
     worker = os.path.join(here, "_dcn_worker.py")
     port = _free_port()
-    nproc = 2
+    nproc = len(counts)
+    counts_arg = ",".join(str(c) for c in counts)
     procs = [
         subprocess.Popen(
-            [sys.executable, worker, str(pid), str(nproc), str(port)],
-            env=_worker_env(4), cwd=os.path.dirname(here),
+            [sys.executable, worker, str(pid), str(nproc), str(port),
+             counts_arg],
+            env=_worker_env(counts[pid]), cwd=os.path.dirname(here),
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
         )
         for pid in range(nproc)
@@ -46,7 +59,7 @@ def test_two_process_distributed_compute():
     outs = []
     try:
         for p in procs:
-            out, _ = p.communicate(timeout=240)
+            out, _ = p.communicate(timeout=timeout)
             outs.append(out)
     finally:
         for p in procs:
@@ -56,3 +69,19 @@ def test_two_process_distributed_compute():
     for pid, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"worker {pid} failed:\n{out[-3000:]}"
         assert f"DCN_OK pid={pid}" in out, out[-3000:]
+
+
+def test_two_process_distributed_compute():
+    _run_job([4, 4])
+
+
+@pytest.mark.skipif(
+    os.environ.get("CK_SKIP_DCN_ASYM") == "1",
+    reason="asymmetric DCN job disabled (CK_SKIP_DCN_ASYM=1)",
+)
+def test_asymmetric_three_process_distributed_compute():
+    """4+2+2 virtual devices across 3 processes (VERDICT r5 #6): unequal
+    per-process steps through the same SPMD balancer — the share table,
+    LCM-step table, and exchange must all hold without the symmetric
+    reshape `multihost_utils.process_allgather` would need."""
+    _run_job([4, 2, 2])

@@ -1,0 +1,463 @@
+"""The span-based attribution subsystem (cekirdekler_tpu/trace/):
+overhead budget, ring-buffer semantics, spans from every runtime layer,
+per-cid fence splitting on a skewed two-kernel window, Chrome-trace
+schema round-trip, and the per-rep overlap ceiling's structural bounds.
+"""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+import cekirdekler_tpu as ct
+from cekirdekler_tpu.arrays.clarray import ClArray
+from cekirdekler_tpu.core.cruncher import NumberCruncher
+from cekirdekler_tpu.trace import (
+    TRACER,
+    RepSample,
+    Span,
+    Tracer,
+    ceiling_report,
+    from_chrome_trace,
+    rep_ceiling,
+    split_fence_benches,
+    to_chrome_trace,
+    tracing,
+    window_report,
+)
+
+SAXPY = """
+__kernel void saxpy(__global float* x, __global float* y, float a) {
+    int i = get_global_id(0);
+    y[i] = y[i] + a * x[i];
+}
+"""
+
+TWO_KERNELS = """
+__kernel void heavy(__global float* x, __global float* y) {
+    int i = get_global_id(0);
+    float acc = x[i];
+    for (int k = 0; k < 40000; k++) { acc = acc + x[i] * 0.25f; }
+    y[i] = acc;
+}
+__kernel void light(__global float* x, __global float* y) {
+    int i = get_global_id(0);
+    y[i] = x[i] + 1.0f;
+}
+"""
+
+
+def _cpus(k=2):
+    return ct.platforms().cpus().subset(k)
+
+
+@pytest.fixture(autouse=True)
+def _tracer_off():
+    """Every test starts and ends with the global tracer disabled — a
+    test that leaks an enabled tracer would tax the whole suite."""
+    TRACER.disable()
+    TRACER.clear()
+    yield
+    TRACER.disable()
+    TRACER.clear()
+
+
+# -- overhead budget ---------------------------------------------------------
+
+def test_disabled_tracer_overhead_under_budget():
+    """The ISSUE's stated budget: a disabled tracer's would-be span costs
+    < 1 µs.  Measured over 50k t0()/record() pairs (the hot-site
+    convention), best of 3 runs to shrug off scheduler noise."""
+    tr = Tracer()
+    assert not tr.enabled
+    n = 50_000
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            t = tr.t0()
+            tr.record("launch", t, cid=1, lane=0)
+        best = min(best, (time.perf_counter() - t0) / n)
+    assert best < 1e-6, f"disabled span cost {best*1e9:.0f} ns >= 1 µs"
+    assert tr.total_recorded == 0  # truly a no-op: nothing stored
+
+
+def test_enabled_tracer_records_and_costs_sanely():
+    tr = Tracer(capacity=1024)
+    tr.enable()
+    n = 1000
+    t0 = time.perf_counter()
+    for i in range(n):
+        t = tr.t0()
+        tr.record("launch", t, cid=i, lane=0, tag="x")
+    per = (time.perf_counter() - t0) / n
+    assert tr.total_recorded == n
+    assert per < 5e-5  # sanity only; the hard budget is the disabled path
+
+
+# -- ring buffer -------------------------------------------------------------
+
+def test_ring_buffer_wraps_keeping_newest():
+    tr = Tracer(capacity=16)
+    tr.enable()
+    for i in range(40):
+        tr.instant("launch", cid=i)
+    spans = tr.snapshot()
+    assert len(spans) == 16
+    assert tr.total_recorded == 40
+    assert sorted(s.cid for s in spans) == list(range(24, 40))
+
+
+def test_record_ignores_disabled_open():
+    """A span opened while disabled must not record even if the tracer
+    was enabled mid-span (t0 == 0.0 sentinel)."""
+    tr = Tracer()
+    t = tr.t0()
+    tr.enable()
+    tr.record("launch", t)
+    assert tr.total_recorded == 0
+
+
+def test_tracing_scope_disables_on_exit():
+    with tracing() as tr:
+        assert tr.enabled
+        tr.instant("split")
+    assert not TRACER.enabled
+    assert len(TRACER.snapshot()) == 1  # spans survive the scope
+
+
+# -- spans from the runtime layers ------------------------------------------
+
+def test_spans_from_worker_cores_and_both_engines():
+    from cekirdekler_tpu.core.cores import PIPELINE_DRIVER, PIPELINE_EVENT
+
+    n = 1024
+    x = ClArray(np.arange(n, dtype=np.float32), partial_read=True,
+                read_only=True)
+    y = ClArray(np.ones(n, np.float32), partial_read=True)
+    cr = NumberCruncher(_cpus(2), SAXPY)
+    try:
+        with tracing() as tr:
+            t0 = time.perf_counter()
+            g = x.next_param(y)
+            g.compute(cr, 11, "saxpy", n, 64, values=(2.0,))
+            g.compute(cr, 11, "saxpy", n, 64, pipeline=True,
+                      pipeline_blobs=4, pipeline_type=PIPELINE_EVENT,
+                      values=(2.0,))
+            g.compute(cr, 11, "saxpy", n, 64, pipeline=True,
+                      pipeline_blobs=4, pipeline_type=PIPELINE_DRIVER,
+                      values=(2.0,))
+            cr.barrier()
+            t1 = time.perf_counter()
+        spans = tr.snapshot()
+        kinds = {s.kind for s in spans}
+        # worker layer
+        assert {"upload", "launch", "download", "fence"} <= kinds
+        # cores layer: the compute() entry + the first range split
+        assert {"enqueue", "split"} <= kinds
+        # both pipeline engines emitted their engine spans
+        engine_tags = {s.tag.split()[0] for s in spans
+                       if s.kind == "pipeline-stage" and s.tag}
+        assert {"EVENT", "DRIVER"} <= engine_tags
+        # cid threading: every launch span carries the compute id
+        launches = [s for s in spans if s.kind == "launch"]
+        assert launches and all(s.cid == 11 for s in launches)
+        assert all(s.lane in (0, 1) for s in launches)
+        # the window report reconciles: coverage cannot exceed wall
+        rep = window_report(spans, t0, t1)
+        assert 0 <= rep.covered_ms <= rep.wall_ms + 1e-6
+        assert rep.gap_ms >= 0
+        assert rep.per_cid[11]["launch"] > 0
+    finally:
+        cr.dispose()
+
+
+def test_spans_from_device_pipeline_and_pool():
+    from cekirdekler_tpu.pipeline.device_pipeline import ClPipeline, PipelineStage
+    from cekirdekler_tpu.pipeline.pool import ClDevicePool, ClTask, ClTaskPool
+
+    n = 256
+    with tracing() as tr:
+        # device pipeline stage spans
+        st1 = PipelineStage(SAXPY, "saxpy", n, 64, values=(1.0,))
+        st1.add_input(np.arange(n, dtype=np.float32))
+        st1.add_output(np.zeros(n, np.float32))
+        st2 = PipelineStage(SAXPY, "saxpy", n, 64, values=(1.0,))
+        st2.add_input(np.zeros(n, np.float32))
+        st2.add_output(np.zeros(n, np.float32))
+        pipe = ClPipeline.make([st1, st2], list(_cpus(2)))
+        try:
+            pipe.push([np.arange(n, dtype=np.float32)])
+            pipe.push([np.arange(n, dtype=np.float32)])
+        finally:
+            pipe.dispose()
+        stage_spans = [s for s in tr.snapshot() if s.kind == "pipeline-stage"]
+        assert len(stage_spans) >= 4  # 2 stages x 2 pushes
+
+        # pool task spans
+        x = ClArray(np.arange(n, dtype=np.float32), read_only=True)
+        y = ClArray(np.zeros(n, np.float32))
+        pool = ClTaskPool()
+        for _ in range(3):
+            pool.add(ClTask(params=[x, y], kernel_names=["saxpy"],
+                            compute_id=5, global_range=n, local_range=64,
+                            values=(1.0,)))
+        with ClDevicePool(_cpus(2), SAXPY) as dp:
+            dp.enqueue_task_pool(pool)
+            dp.finish()
+        pool_spans = [s for s in tr.snapshot() if s.kind == "pool-task"]
+        assert len(pool_spans) == 3
+        assert all(s.cid == 5 for s in pool_spans)
+
+
+# -- fence split -------------------------------------------------------------
+
+def test_split_fence_benches_marginals():
+    t0 = 100.0
+    comps = [(1, 100.010), (2, 100.011), (3, 100.050)]
+    b = split_fence_benches(comps, t0)
+    assert b[1] == pytest.approx(10.0, abs=1e-6)
+    assert b[2] == pytest.approx(1.0, abs=1e-6)
+    assert b[3] == pytest.approx(39.0, abs=1e-6)
+    # out-of-order clock jitter clamps at 0, never negative
+    b2 = split_fence_benches([(1, 100.010), (2, 100.009)], t0)
+    assert b2[2] == 0.0
+
+
+def test_fence_split_attributes_skewed_two_kernel_window():
+    """The VERDICT r5 #8 distortion, measured and closed: a mixed
+    enqueue window of a heavy and a light kernel.  Without the split
+    both compute ids inherit the whole-window fence time (the documented
+    approximation); with ``fence_split`` the light kernel's bench must
+    come out a small fraction of the heavy one's."""
+    n = 8192
+    x = ClArray(np.arange(n, dtype=np.float32) % 7, partial_read=True,
+                read_only=True)
+    yh = ClArray(n, np.float32, name="tyh", partial_read=True)
+    yl = ClArray(n, np.float32, name="tyl", partial_read=True)
+
+    def window(split: bool):
+        cr = NumberCruncher(_cpus(2), TWO_KERNELS)
+        try:
+            cr.fence_split = split
+            cr.enqueue_mode = True
+            for _ in range(3):
+                x.next_param(yh).compute(cr, 31, "heavy", n, 256)
+            for _ in range(3):
+                x.next_param(yl).compute(cr, 32, "light", n, 256)
+            cr.barrier()
+            heavy = cr.benchmarks_of(31)
+            light = cr.benchmarks_of(32)
+            cr.enqueue_mode = False
+            return heavy, light
+        finally:
+            if cr.enqueue_mode:
+                cr.enqueue_mode = False
+            cr.dispose()
+
+    heavy0, light0 = window(split=False)
+    # the documented default: one fence time for every id in the window
+    assert heavy0 == light0
+    heavy1, light1 = window(split=True)
+    for h, l in zip(heavy1, light1):
+        assert h > 0 and l >= 0
+        # the skew is ~1000x on this kernel pair; 5x is a safe floor
+        # that still fails hard if the split regresses to whole-window
+        assert l < h / 5.0, (heavy1, light1)
+    # correctness survives the split path (flush after the barrier)
+    np.testing.assert_allclose(
+        np.asarray(yl.host()), np.asarray(x.host()) + 1.0
+    )
+
+
+def test_fence_split_correct_results_and_rebalance_arming():
+    """The split path must leave the sync-point rebalance machinery
+    working: ids still arm, ranges still move on the next call."""
+    n = 4096
+    x = ClArray(np.arange(n, dtype=np.float32), partial_read=True,
+                read_only=True)
+    y = ClArray(np.ones(n, np.float32), partial_read=True)
+    cr = NumberCruncher(_cpus(2), SAXPY)
+    try:
+        cr.fence_split = True
+        cr.enqueue_mode = True
+        for _ in range(4):
+            x.next_param(y).compute(cr, 41, "saxpy", n, 64, values=(1.0,))
+        cr.barrier()
+        assert 41 in cr.cores._enqueue_rebalance
+        x.next_param(y).compute(cr, 41, "saxpy", n, 64, values=(1.0,))
+        cr.enqueue_mode = False
+        np.testing.assert_allclose(
+            np.asarray(y.host()),
+            1.0 + 5.0 * np.arange(n, dtype=np.float32),
+        )
+    finally:
+        if cr.enqueue_mode:
+            cr.enqueue_mode = False
+        cr.dispose()
+
+
+# -- chrome export -----------------------------------------------------------
+
+def test_chrome_trace_roundtrip_schema():
+    base = time.perf_counter()
+    spans = [
+        Span("launch", base, base + 0.005, cid=7, lane=0, tag="k1 x2"),
+        Span("upload", base + 0.001, base + 0.002, cid=7, lane=1, tag="a"),
+        Span("fence", base + 0.006, base + 0.009, cid=None, lane=None,
+             tag="barrier"),
+    ]
+    trace = to_chrome_trace(spans)
+    # schema facts chrome://tracing / Perfetto rely on
+    blob = json.dumps(trace)
+    parsed = json.loads(blob)
+    evs = parsed["traceEvents"]
+    xs = [e for e in evs if e["ph"] == "X"]
+    assert len(xs) == len(spans)
+    for e in xs:
+        assert {"name", "pid", "tid", "ts", "dur"} <= set(e)
+        assert e["ts"] >= 0 and e["dur"] >= 0
+    names = {e["args"]["name"] for e in evs if e["ph"] == "M"
+             and e["name"] == "thread_name"}
+    assert {"host", "lane 0", "lane 1"} <= names
+    # round trip: kinds, cids, lanes, tags, durations survive
+    back = from_chrome_trace(parsed)
+    assert len(back) == len(spans)
+    orig = sorted(spans, key=lambda s: s.t0)
+    for a, b in zip(orig, back):
+        assert a.kind == b.kind and a.cid == b.cid and a.lane == b.lane
+        assert a.tag == b.tag
+        assert b.dur_ms == pytest.approx(a.dur_ms, rel=1e-6)
+
+
+# -- overlap ceiling ---------------------------------------------------------
+
+def test_rep_ceiling_witness_clamp_and_bounds():
+    # good engine: achieved lands near the model's prediction
+    s = RepSample(r=10.0, c=30.0, w=10.0, p=33.0, h2d=10.0, d2h=10.0,
+                  dup=12.0)
+    r = rep_ceiling(s, blobs=8)
+    assert r["achieved_vs_ceiling"] is not None
+    assert 0.9 <= r["achieved_vs_ceiling"] <= 1.0
+    # engine beats the model (the r5 1.15 case): ratio saturates at 1.0,
+    # flagged — never above
+    s2 = RepSample(r=10.0, c=30.0, w=10.0, p=29.0, h2d=10.0, d2h=10.0,
+                   dup=20.0)
+    r2 = rep_ceiling(s2, blobs=8)
+    assert r2["model_beaten"]
+    assert r2["achieved_vs_ceiling"] == pytest.approx(1.0)
+    # poor engine: honestly below — no clipping upward
+    s3 = RepSample(r=10.0, c=30.0, w=10.0, p=48.0, h2d=10.0, d2h=10.0,
+                   dup=12.0)
+    r3 = rep_ceiling(s3, blobs=8)
+    assert r3["achieved_vs_ceiling"] < 0.9
+
+
+def test_rep_ceiling_ratio_in_unit_interval_under_noise():
+    """Property sweep: whatever the (noisy) inputs, the per-rep ratio is
+    a [0, 1] fraction — the structural guarantee that fixes the
+    broken-ruler finding (negative-overlap reps floor at 0 and are
+    counted by ceiling_report, never fed raw into the median)."""
+    rng = np.random.default_rng(0)
+    for _ in range(500):
+        vals = rng.uniform(0.1, 50.0, size=7)
+        s = RepSample(*[float(v) for v in vals])
+        r = rep_ceiling(s, blobs=int(rng.integers(2, 17)))
+        if r["achieved_vs_ceiling"] is not None:
+            assert 0.0 <= r["achieved_vs_ceiling"] <= 1.0 + 1e-9
+
+
+def test_ceiling_report_counts_negative_overlap_reps():
+    # p > serial: pipelining ran SLOWER than serial — achieved < 0
+    bad = RepSample(r=1.0, c=5.0, w=1.0, p=8.0, h2d=1.0, d2h=1.0, dup=1.2)
+    rep = ceiling_report([bad], blobs=4)
+    assert rep["negative_overlap_reps"] == 1
+    assert rep["achieved_vs_ceiling"] == 0.0  # floored, not negative
+
+
+def test_ceiling_report_medians_and_spread():
+    reps = [
+        RepSample(r=10, c=30, w=10, p=33, h2d=10, d2h=10, dup=12),
+        RepSample(r=11, c=31, w=9, p=32, h2d=10, d2h=10, dup=13),
+        RepSample(r=9, c=29, w=11, p=34, h2d=10, d2h=10, dup=11),
+    ]
+    rep = ceiling_report(reps, blobs=8)
+    assert rep["n_reps"] == 3
+    assert len(rep["per_rep_achieved_vs_ceiling"]) == 3
+    assert rep["achieved_vs_ceiling"] <= 1.0
+    assert rep["achieved_vs_ceiling_spread"] >= 0.0
+    assert 0.9 <= rep["achieved_vs_ceiling"] <= 1.0
+
+
+def test_measure_stream_overlap_per_rep_ceiling_keys():
+    """Live rig smoke: the overlap measurement carries the per-rep
+    ceiling keys with their structural bounds (the rig's memcpy
+    'transfers' make the absolute numbers meaningless — the BOUNDS and
+    the schema are what the artifact contract pins)."""
+    from cekirdekler_tpu.workloads import measure_stream_overlap
+
+    ov = measure_stream_overlap(
+        _cpus(1), n=1 << 14, blobs=4, reps=2, heavy_iters=2000,
+        duplex_probe=True,
+    )
+    assert ov["n_reps"] == 2
+    assert len(ov["per_rep_achieved_vs_ceiling"]) <= 2
+    avc = ov["achieved_vs_ceiling"]
+    if avc is not None:
+        assert avc <= 1.0 + 1e-9  # the ruler bounds from above, always
+        assert ov["achieved_vs_ceiling_spread"] is not None
+    assert 0.0 <= ov["duplex_capacity"] <= 1.0
+    assert 0.0 <= ov["overlap_ceiling"] <= 1.0
+
+
+# -- nbody e2e attribution ---------------------------------------------------
+
+def test_nbody_e2e_attribution_names_the_factors():
+    from cekirdekler_tpu.workloads import nbody_e2e
+
+    out = nbody_e2e(
+        _cpus(2), n=512, iters=12, window=4, attribution=True,
+        probe_iters=4,
+    )
+    assert out["checked"]
+    att = out["attribution"]
+    f = att["factors"]
+    for name in ("window_rtt", "ladder_launch", "upload",
+                 "download_flush", "scheduler_dispatch", "host_gap"):
+        assert name in f, f.keys()
+        assert f[name]["ms"] >= 0.0
+        assert f[name]["frac"] is None or f[name]["frac"] >= 0.0
+    # 12 iters / window 4 → 3 barriers
+    assert f["window_rtt"]["count"] == 3
+    assert f["ladder_launch"]["count"] >= 12  # ≥1 dispatch span per iter
+    li = att["lane_interference"]
+    assert "factor" in li, li
+    assert li["factor"] > 0
+    assert li["lanes"] == 2
+    # the attribution run must not leave the global tracer enabled
+    assert not TRACER.enabled
+
+
+def test_fori_chain_bench_fallback_refuses_dceable_feedback():
+    import jax.numpy as jnp
+
+    from cekirdekler_tpu.workloads import fori_chain_bench
+
+    a = jnp.ones((8, 8), jnp.float32)
+    b = jnp.ones((4, 4), jnp.float32)
+
+    # two output leaves that do not pair with the carries: leaves[1:]
+    # would silently DCE out of the loop — must refuse
+    def bad_step(x, y):
+        return x * 1.0001, jnp.sum(y, keepdims=True)
+
+    with pytest.raises(ValueError, match="DCE-able"):
+        fori_chain_bench(bad_step, (a, b), reps=2, trials=1)
+
+    # single output leaf matching a carry: the documented fallback works
+    def ok_step(x, y):
+        return x * 1.0001 + y[:1, :1].sum()
+
+    dt = fori_chain_bench(ok_step, (a, b), reps=2, trials=1)
+    assert dt > 0
