@@ -489,18 +489,21 @@ class SectionScheduler:
 
 
 # must-run reservations: the two sections the r5 verdict ordered, plus
-# flash_train — the r6 acceptance-gate metric (T8192 mfu_default): all
-# three must reach the artifact even on a slow-tunnel day.  Their slices
-# are what OTHER sections' budget checks subtract (so best-effort middle
+# flash_train — the r6 acceptance-gate metric (T8192 mfu_default) whose
+# re-measure rides THIS slice into the artifact of record — plus
+# dispatch_floor, the r8 fused-dispatch gate evidence (the r4/r5 lesson:
+# a gate metric without a reservation starves two rounds in a row): all
+# must reach the artifact even on a slow-tunnel day.  Their slices are
+# what OTHER sections' budget checks subtract (so best-effort middle
 # sections skip BEFORE eating the reserved tail); the sections themselves
 # bound their own runtime internally (fixed reps / internal budgets).
-# Sizing trade: 850s reserved of the 1500s default leaves best-effort
-# sections a 650s window (shrinking reservations release as must-runs
+# Sizing trade: 940s reserved of the 1500s default leaves best-effort
+# sections a 560s window (shrinking reservations release as must-runs
 # complete) — on a good day everything still runs (r5 pre-flash sections
 # fit well inside that); on a bad day the gates win, which is the
 # explicit priority ordering the r5 verdict asked for.
 RESERVED_SECTIONS = {"flash_train": 360.0, "marker_overhead": 60.0,
-                     "dtype_matrix": 430.0}
+                     "dtype_matrix": 430.0, "dispatch_floor": 90.0}
 
 
 _OVERLAP_KEYS = (
@@ -656,9 +659,21 @@ def main() -> None:
 
     # attribution=True (VERDICT r5 #3): the result names each factor of
     # the e2e-vs-device gap — window RTT, ladder launch, upload/download,
-    # scheduler dispatch, host gap, lane interference — with a
-    # measurement, via the trace subsystem (docs/OBSERVABILITY.md)
+    # scheduler dispatch, fused-window flushes, host gap, lane
+    # interference — with a measurement, via the trace subsystem
+    # (docs/OBSERVABILITY.md).  Fused dispatch is ON (the production
+    # default, ISSUE 3); its windows/disengage counts ride the result's
+    # `fused` key, and a per-iteration reference row rides
+    # dispatch_floor below.
     nbe = section("nbody_e2e", lambda: nbody_e2e(devs, attribution=True))
+
+    # Dispatch-floor sweep (ISSUE 3 satellite): per-dispatch overhead vs
+    # window size K, per-iteration vs fused — the direct evidence that
+    # the enqueue floor collapsed (reserved must-run slice; the r4/r5
+    # starvation lesson).
+    from cekirdekler_tpu.workloads import dispatch_floor_sweep
+
+    dfloor = section("dispatch_floor", lambda: dispatch_floor_sweep())
 
     # Balancer on the 8-device rig with skewed per-range load (r2 #4).
     rig = section("balancer_rig", balancer_rig_section)
@@ -737,6 +752,7 @@ def main() -> None:
         "nbody_gpairs_per_sec": round(nb["gpairs_per_sec"], 3),
         "nbody_checked": bool(nb["checked"]),
         "nbody_e2e": nbe,
+        "dispatch_floor": dfloor,
         "nbody_note": (
             "nbody_gpairs_per_sec = sync-per-call variant (host fence "
             "every iteration, RTT-bound — a dispatch-latency metric); "
@@ -797,6 +813,14 @@ def main() -> None:
             "nbody_sync_per_call_gpairs": round(nb["gpairs_per_sec"], 3),
             "nbody_e2e_enqueue_gpairs": (
                 nbe.get("gpairs_per_sec") if isinstance(nbe, dict) else None
+            ),
+            "nbody_e2e_fused_iters": (
+                (nbe.get("fused") or {}).get("fused_iters")
+                if isinstance(nbe, dict) else None
+            ),
+            "dispatch_floor_collapse": (
+                dfloor.get("floor_collapse_at_kmax")
+                if isinstance(dfloor, dict) else None
             ),
             "dtype_cells": (
                 f"{dtypes.get('cells_pass')}p/{dtypes.get('cells_veto')}v/"

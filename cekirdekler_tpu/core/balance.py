@@ -29,6 +29,7 @@ __all__ = [
     "BalanceHistory",
     "BalanceState",
     "equal_split",
+    "per_iteration_benches",
     "DAMPING",
     "HISTORY_DEPTH",
 ]
@@ -97,6 +98,26 @@ class BalanceState:
         self.cont = [float(r) for r in ranges]
         self.prev_delta = [0.0] * len(ranges)
         self.damp = [damping] * len(ranges)
+
+
+def per_iteration_benches(
+    window_ms: dict[int, float], iters: dict[int, int]
+) -> dict[int, float]:
+    """Window-granularity balancer feedback (the fused-dispatch contract,
+    core/cores.py): an enqueue window measures each compute id's cost
+    over the WHOLE window — one fence-retire time, or a per-cid marginal
+    when the fence split is on — while the window may contain many
+    iterations of that id (and, with the fused path, those iterations are
+    one dispatch).  Normalizing to per-iteration milliseconds keeps the
+    bench scale comparable across windows of different sizes, so the
+    balancer's quantization-freeze threshold and the adaptive damping see
+    a consistent signal whether a window held 1 iteration or 128.
+
+    Per-device share ratios are unaffected (every device divides by the
+    same count), so this changes reporting consistency, not splits."""
+    return {
+        cid: ms / max(1, iters.get(cid, 1)) for cid, ms in window_ms.items()
+    }
 
 
 def equal_split(total: int, num: int, step: int) -> list[int]:

@@ -29,6 +29,7 @@ import threading
 import time
 from collections import deque
 from concurrent.futures import ThreadPoolExecutor
+from contextlib import ExitStack, nullcontext
 from dataclasses import dataclass, field
 from typing import Any, Sequence
 
@@ -38,7 +39,13 @@ from ..hardware import Devices
 from ..kernel.registry import KernelProgram
 from ..trace.attribution import split_fence_benches
 from ..trace.spans import TRACER
-from .balance import BalanceHistory, BalanceState, equal_split, load_balance
+from .balance import (
+    BalanceHistory,
+    BalanceState,
+    equal_split,
+    load_balance,
+    per_iteration_benches,
+)
 from .worker import Worker
 
 __all__ = ["Cores", "PIPELINE_EVENT", "PIPELINE_DRIVER", "ComputePerf"]
@@ -66,6 +73,27 @@ class ComputePerf:
             )
         text = "\n".join(lines)
         return text
+
+
+@dataclass
+class _FusedRun:
+    """State of one ACTIVE fused-iteration window: the signature every
+    deferral is matched against, plus everything needed to dispatch the
+    accumulated iterations as one ladder per device at a flush point."""
+
+    sig: tuple
+    compute_id: int
+    kernel_names: tuple
+    params: tuple
+    value_args: Any
+    local_range: int
+    global_range: int
+    step: int
+    # per active worker: (worker, global offset, range size)
+    rows: list = field(default_factory=list)
+    # coverage-epoch snapshot at engage: (worker, epoch) — ONE int compare
+    # per worker per deferral detects any mid-window coverage reset
+    epochs: list = field(default_factory=list)
 
 
 class Cores:
@@ -113,6 +141,51 @@ class Cores:
         self._enqueue_cids: set[int] = set()
         self._enqueue_t0: float | None = None
         self._enqueue_rebalance: set[int] = set()
+        # per-window iteration counts per compute id: the balancer's
+        # window-granularity feedback normalizes fence-retire times to
+        # per-iteration benches (balance.per_iteration_benches) so windows
+        # of different sizes feed a consistent scale
+        self._enqueue_iters: dict[int, int] = {}
+        # monotone sequence tag on deferred readback records — flush()
+        # orders host writes chronologically by it (list indices stopped
+        # being chronological once per-worker flushes could interleave)
+        self._enqueue_seq = 0
+        # ---- fused-iteration dispatch (the enqueue dispatch-floor
+        # collapse): when an enqueue window repeats the same compute id
+        # with unchanged ranges and HBM-resident operands, calls after the
+        # first are DEFERRED (a counter increment) and dispatched in
+        # batches as ONE dynamic-iteration-count ladder executable per
+        # device (Worker.launch_fused / KernelProgram.fused_launcher),
+        # through a depth-limited per-device driver queue so device B's
+        # ladder dispatch overlaps device A's execution.  Rebalance
+        # decisions stay at window boundaries (barrier), fed per-iteration
+        # marginal times.  fused_batch bounds how many iterations one
+        # dispatch carries (the eager sub-batch: the device starts working
+        # mid-window instead of at the barrier); fused_queue_depth bounds
+        # the per-device host dispatch backlog.
+        self.fused_dispatch = True
+        self.fused_batch = 16
+        self.fused_queue_depth = 2
+        self._fused_sig: tuple | None = None
+        self._fused_run: _FusedRun | None = None
+        # last per-call enqueue signature: a window engages only on a
+        # CONSECUTIVE repeat, so a window that never repeats (mixed cids
+        # ping-ponging A,B,A,B) pays one tuple compare per call instead
+        # of an engage/break(close+drain) cycle per call
+        self._fused_candidate: tuple | None = None
+        self._fused_pending = 0
+        # serializes [grab pending + submit to drivers] so a close/drain
+        # cannot slip between a concurrent flush's grab and its submits
+        # (downloads would then precede the in-flight ladder and the host
+        # would miss those iterations)
+        self._fused_mu = threading.Lock()
+        # observability: windows dispatched, iterations fused, and every
+        # disengage with its named reason — a perf regression to the
+        # per-iteration path must be attributable, never silent
+        self.fused_stats: dict[str, Any] = {
+            "windows": 0, "fused_iters": 0, "deferred_iters": 0,
+            "disengaged": {},
+        }
         # per-cid fence splitting (VERDICT r5 #8): when on, barrier()
         # fences each compute id's last output in last-dispatch order and
         # feeds the balancer MARGINAL per-cid times instead of charging
@@ -273,31 +346,65 @@ class Cores:
         # correct across a move because workers skip re-uploads only for
         # covered ranges (Worker.upload_covers).
         #
-        # KNOWN LIMIT (present since the seed, surfaced by the r7 trace
-        # hammer): enqueue windows must be driven by ONE host thread.
-        # With several threads enqueuing different cids while one
-        # barriers, an armed rebalance's flush+reset_coverage can
-        # interleave with another thread's in-flight window — that
-        # thread's next covered-range check then re-uploads a host copy
-        # missing its own post-flush device increments (lost updates,
-        # measured 10-12/12 arrays on the 2-lane rig at seed, with or
-        # without fence_split).  The concurrent-thread contract
-        # (Worker.lock) covers the NON-enqueue path; fixing the enqueue
-        # variant needs window-scoped coverage epochs — future PR.
+        # Fused-iteration fast path: with an active fused window whose
+        # signature this call matches, the call is a counter increment —
+        # the accumulated iterations dispatch in batches as ONE ladder
+        # executable per device (see _fused_try_engage).  Every break-out
+        # names its reason (fused_stats + a "fused" trace instant) so a
+        # regression to per-iteration dispatch is attributable.
+        if self.enqueue_mode and self._fused_sig is not None and not pipeline:
+            sig = self._fused_signature(
+                kernel_names, params, compute_id, global_range,
+                local_range, global_offset, value_args,
+            )
+            if self._sig_equal(sig, self._fused_sig):
+                run = self._fused_run
+                # the runtime mode toggles are NOT part of the signature
+                # (they are cruncher state, not call identity) — re-check
+                # them per deferral, else flipping one mid-window would
+                # silently defer a call whose semantics changed (e.g.
+                # repeat_count=3 deferring as ONE iteration)
+                mode_change = (
+                    not self.fused_dispatch
+                    or self.no_compute_mode
+                    or self.repeat_count > 1
+                    or self.repeat_sync_kernel
+                    or self.dispatch_gate is not None
+                    or self.trace_lanes
+                )
+                if mode_change:
+                    # clear the candidate so this call's tail records ONE
+                    # event ("mode-change"), not a second engage-refusal
+                    # under another name for the same call
+                    self._fused_candidate = None
+                    self._fused_break("mode-change")
+                elif compute_id in self._enqueue_rebalance:
+                    # a barrier armed a rebalance: ranges may move — the
+                    # window's pinned per-device rows are no longer valid
+                    self._fused_break("range-change")
+                elif run is not None and any(
+                    w.coverage_epoch != ep for w, ep in run.epochs
+                ):
+                    # a sync-point rebalance (possibly another thread's)
+                    # reset upload coverage mid-window: operands are no
+                    # longer guaranteed HBM-resident for these rows
+                    self._fused_break("non-resident")
+                elif self._fused_defer(t_start, kernel_names):
+                    return
+            else:
+                self._fused_break("signature-change")
+        elif self._fused_sig is not None and pipeline:
+            self._fused_break("pipeline")
+        elif self._fused_sig is not None and not self.enqueue_mode:
+            # leaving enqueue mode without flush() (callers normally go
+            # through the cruncher setter, which flushes)
+            self._fused_break("enqueue-off")
         if self.enqueue_mode:
-            if self._enqueue_t0 is None:
-                self._enqueue_t0 = t_start
             # under the lock: concurrent host threads may drive different
             # compute ids through one Cores, and the order list's
             # remove+append is not atomic like the set add is
             with self._lock:
-                if compute_id in self._enqueue_cids:
-                    # keep the order list in LAST-dispatch order — the
-                    # fence split probes completions ascending, and a
-                    # cid's last launch is what its probe waits on
-                    self._enqueue_cid_order.remove(compute_id)
-                self._enqueue_cid_order.append(compute_id)
-                self._enqueue_cids.add(compute_id)
+                self._note_enqueue_call(compute_id, t_start)
         old_ranges = list(self.global_ranges.get(compute_id, ()))
         ranges, refs = self._ranges_for(
             compute_id,
@@ -320,11 +427,18 @@ class Cores:
             # and every chip's upload-coverage record is reset, else a chip
             # RE-acquiring a range it held before an earlier move would
             # pass upload_covers() on stale coverage and skip the fetch of
-            # data another chip updated in between
-            self.flush()
-            for w in self.workers:
-                with w.lock:
-                    w.reset_coverage()
+            # data another chip updated in between.  The flush and the
+            # reset are ONE atomic step under every worker's lock
+            # (_flush_and_reset_coverage): interleaved with another host
+            # thread's in-flight enqueue window, a non-atomic
+            # flush-then-reset let that thread launch between the two and
+            # then re-upload a host copy missing its own increments — the
+            # r7 KNOWN LIMIT's lost updates, now closed by the
+            # window-scoped coverage epoch (each reset bumps
+            # Worker.coverage_epoch; fused windows check it per deferral,
+            # per-call windows re-upload from a host made current inside
+            # the same atomic step).
+            self._flush_and_reset_coverage()
         # a chip whose share was quantized to zero never re-runs its bench;
         # decay its stale measurement so a one-off slow call (e.g. first-call
         # compile) cannot starve it permanently
@@ -382,6 +496,20 @@ class Cores:
             "enqueue", t_start, cid=compute_id,
             tag="+".join(kernel_names),
         )
+        self._record_perf(compute_id, t_start, ranges)
+        # fused-window engagement: a successfully dispatched enqueue call
+        # whose next identical call would be a pure launch (operands
+        # resident, ranges pinned) establishes the window this call's
+        # geometry defines — subsequent matching calls defer
+        if self.enqueue_mode and self.fused_dispatch and not pipeline:
+            self._fused_try_engage(
+                kernel_names, params, compute_id, global_range,
+                local_range, global_offset, value_args, ranges, refs, step,
+            )
+
+    def _record_perf(
+        self, compute_id: int, t_start: float, ranges: list[int]
+    ) -> None:
         perf = ComputePerf(
             compute_id=compute_id,
             device_ms=[w.benchmarks.get(compute_id, 0.0) for w in self.workers],
@@ -393,6 +521,245 @@ class Cores:
         self.last_compute_id = compute_id
         if self.performance_feed:
             print(perf.report(self.device_names()))
+
+    # -- fused-iteration dispatch (the enqueue dispatch-floor collapse) ------
+    @staticmethod
+    def _sig_equal(a: tuple | None, b: tuple | None) -> bool:
+        """Signature equality that treats ANY comparison failure as a
+        mismatch: array-valued value args make tuple ``==`` raise
+        (ambiguous elementwise truth) — such a call must take the
+        signature-change path, never crash mid-window."""
+        if a is None or b is None:
+            return False
+        try:
+            return bool(a == b)
+        except Exception:  # noqa: BLE001 - mismatch by definition
+            return False
+
+    def _note_enqueue_call(self, compute_id: int, t_start: float) -> None:
+        """Window bookkeeping shared by the per-call and deferred paths
+        (one code path on purpose: the cid order feeds the fence split,
+        the iteration counts feed the balancer's per-iteration
+        normalization).  Caller holds the scheduler lock."""
+        if self._enqueue_t0 is None:
+            self._enqueue_t0 = t_start
+        if compute_id in self._enqueue_cids:
+            # keep the order list in LAST-dispatch order — the fence
+            # split probes completions ascending, and a cid's last
+            # launch is what its probe waits on
+            self._enqueue_cid_order.remove(compute_id)
+        self._enqueue_cid_order.append(compute_id)
+        self._enqueue_cids.add(compute_id)
+        self._enqueue_iters[compute_id] = (
+            self._enqueue_iters.get(compute_id, 0) + 1
+        )
+
+    def _fused_signature(
+        self, kernel_names, params, compute_id, global_range,
+        local_range, global_offset, value_args,
+    ) -> tuple:
+        """Identity of one repeatable enqueue call.  Params enter by
+        OBJECT identity: the workers' buffer caches key on id(arr), so a
+        different array object is a different dispatch even at equal
+        shapes."""
+        if isinstance(value_args, dict):
+            vals: Any = tuple(
+                (k, tuple(v)) for k, v in sorted(value_args.items())
+            )
+        else:
+            vals = tuple(value_args)
+        return (
+            compute_id, tuple(kernel_names), tuple(id(p) for p in params),
+            global_range, local_range, global_offset, vals,
+        )
+
+    def _fused_try_engage(
+        self, kernel_names, params, compute_id, global_range,
+        local_range, global_offset, value_args, ranges, refs, step,
+    ) -> None:
+        """Open a fused window for this call's signature, or record WHY
+        not (fused_stats["disengaged"] + a "fused" trace instant) — every
+        refusal reason is observable so a silent fall-back to
+        per-iteration dispatch cannot masquerade as device slowness.
+
+        Engagement requires a CONSECUTIVE repeat of the signature: the
+        first sighting only seeds the candidate, so a window that never
+        repeats (mixed cids alternating every call) costs one tuple
+        compare per call — no engage walk, no break/drain cycle, and no
+        misleading disengage stats for calls that were never going to
+        fuse."""
+        sig = self._fused_signature(
+            kernel_names, params, compute_id, global_range,
+            local_range, global_offset, value_args,
+        )
+        candidate, self._fused_candidate = self._fused_candidate, sig
+        if not self._sig_equal(sig, candidate):
+            return
+        reason = None
+        if self.no_compute_mode:
+            reason = "no-compute"
+        elif self.repeat_count > 1 or self.repeat_sync_kernel:
+            # each call already fuses its repeats on device
+            # (sequence_launcher); cross-call fusion would change the
+            # sync-kernel interleaving contract
+            reason = "repeat-mode"
+        elif self.dispatch_gate is not None:
+            reason = "dispatch-gate"
+        elif self.trace_lanes:
+            reason = "trace-lanes"
+        if reason is None:
+            try:
+                hash(sig)
+            except TypeError:
+                reason = "unhashable-values"
+        rows: list = []
+        epochs: list = []
+        if reason is None:
+            single = self.num_devices == 1
+            covered = True
+            for i, w in enumerate(self.workers):
+                if ranges[i] <= 0:
+                    continue
+                off = global_offset + refs[i]
+                rows.append((w, off, ranges[i]))
+                epochs.append((w, w.coverage_epoch))
+                for p in params:
+                    fl = p.flags
+                    if fl.read and not fl.write_only:
+                        epw = fl.elements_per_work_item
+                        full = single or not fl.partial_read
+                        covered &= w.upload_covers(
+                            p,
+                            0 if full else off * epw,
+                            p.size if full else ranges[i] * epw,
+                        )
+            if not covered:
+                # this call needed a partial upload the window would have
+                # to repeat — the deferral contract (pure launch) fails
+                reason = "partial-upload"
+        if reason is not None:
+            with self._lock:
+                d = self.fused_stats["disengaged"]
+                d[reason] = d.get(reason, 0) + 1
+            TRACER.instant("fused", cid=compute_id, tag=f"disengage:{reason}")
+            return
+        run = _FusedRun(
+            sig=sig, compute_id=compute_id,
+            kernel_names=tuple(kernel_names), params=tuple(params),
+            value_args=value_args, local_range=local_range,
+            global_range=global_range, step=step, rows=rows, epochs=epochs,
+        )
+        with self._lock:
+            self._fused_sig = sig
+            self._fused_run = run
+
+    def _fused_defer(self, t_start: float, kernel_names) -> bool:
+        """Count this call into the active fused window.  Returns False
+        when the window was concurrently closed (caller falls through to
+        the per-call path)."""
+        with self._lock:
+            run = self._fused_run
+            if run is None or self._fused_sig is None:
+                return False
+            cid = run.compute_id
+            self._note_enqueue_call(cid, t_start)
+            self._fused_pending += 1
+            pending = self._fused_pending
+            self.fused_stats["deferred_iters"] += 1
+        if pending >= max(1, int(self.fused_batch)):
+            self._fused_flush()
+        TRACER.record(
+            "enqueue", t_start, cid=cid,
+            tag="+".join(kernel_names) + " fused-defer",
+        )
+        self._record_perf(cid, t_start, self.global_ranges.get(cid, []))
+        return True
+
+    def _dispatch_fused(self, run: _FusedRun, iters: int) -> None:
+        """Submit one K-iteration ladder dispatch per active device to the
+        per-device driver queues (host-side dispatch of device B's ladder
+        overlaps device A's execution; FIFO per device)."""
+        _tt = TRACER.t0()
+        try:
+            for w, off, size in run.rows:
+                def dispatch(w=w, off=off, size=size, run=run, iters=iters):
+                    with w.lock:
+                        w.start_bench(run.compute_id)
+                        try:
+                            w.launch_fused(
+                                self.program, run.kernel_names, run.params,
+                                run.value_args, off, size, run.local_range,
+                                run.global_range, run.step, iters,
+                                compute_id=run.compute_id,
+                            )
+                        finally:
+                            w.end_bench(run.compute_id)
+
+                w.dispatch_async(dispatch, depth=self.fused_queue_depth)
+        except Exception:
+            # a submit failure (a driver re-raising an earlier error)
+            # after some rows were queued leaves devices with DIVERGED
+            # iteration counts for this batch — poison the window so a
+            # caller that catches the error cannot keep deferring into
+            # it (the next call goes per-call; the cruncher's error gate
+            # additionally refuses further work until reset)
+            with self._lock:
+                self._fused_sig = None
+                self._fused_run = None
+                self._fused_candidate = None
+            raise
+        with self._lock:
+            self.fused_stats["windows"] += 1
+            self.fused_stats["fused_iters"] += iters
+        TRACER.record("fused", _tt, cid=run.compute_id, tag=f"x{iters}")
+
+    def _fused_flush(self) -> None:
+        """Dispatch the accumulated deferred iterations (window stays
+        open).  Under _fused_mu so a concurrent close cannot drain the
+        drivers between our pending-grab and our submits."""
+        with self._fused_mu:
+            with self._lock:
+                run, k = self._fused_run, self._fused_pending
+                self._fused_pending = 0
+            if run is not None and k > 0:
+                self._dispatch_fused(run, k)
+
+    def _fused_close(self) -> None:
+        """End the fused window at a sync point: stop deferrals, dispatch
+        the residue, and drain the per-device drivers (host-side dispatch
+        complete — device completion is the caller's fence).  Each new
+        window re-engages through its first per-call iteration."""
+        with self._fused_mu:
+            with self._lock:
+                run, k = self._fused_run, self._fused_pending
+                self._fused_pending = 0
+                self._fused_sig = None
+                self._fused_run = None
+            if run is not None and k > 0:
+                self._dispatch_fused(run, k)
+        self._fused_drain()
+
+    def _fused_break(self, reason: str) -> None:
+        """_fused_close plus the disengage bookkeeping: the named reason
+        lands in fused_stats and as a "fused" trace instant."""
+        with self._lock:
+            run = self._fused_run
+        cid = run.compute_id if run is not None else None
+        self._fused_close()
+        with self._lock:
+            d = self.fused_stats["disengaged"]
+            d[reason] = d.get(reason, 0) + 1
+        TRACER.instant("fused", cid=cid, tag=f"disengage:{reason}")
+
+    def _fused_drain(self) -> None:
+        errs: list[Exception] = []
+        for w in self.workers:
+            try:
+                w.drain_dispatch()
+            except Exception as e:  # noqa: BLE001 - surfaced below
+                errs.append(e)
+        if errs:
+            raise errs[0]
 
     # -- per-worker phase (reference: Cores.cs:746-835 / 1197-1980) ----------
     def _run_worker(
@@ -491,7 +858,11 @@ class Cores:
                     # ownership rule as the immediate paths
                     if not fl.write_all or w.index == write_all_owner.get(idx):
                         with self._lock:
-                            self._enqueued.append((w, p, offset, size, fl.write_all))
+                            self._enqueue_seq += 1
+                            self._enqueued.append(
+                                (self._enqueue_seq, w, p, offset, size,
+                                 fl.write_all)
+                            )
                     continue
                 epw = fl.elements_per_work_item
                 if fl.write_all:
@@ -567,12 +938,18 @@ class Cores:
                 if w.index == write_all_owner.get(idx):
                     if self.enqueue_mode:
                         with self._lock:
-                            self._enqueued.append((w, p, 0, p.size, True))
+                            self._enqueue_seq += 1
+                            self._enqueued.append(
+                                (self._enqueue_seq, w, p, 0, p.size, True)
+                            )
                     else:
                         handles.append(w.download_async(p, 0, p.size, True))
             elif self.enqueue_mode:
                 with self._lock:
-                    self._enqueued.append((w, p, offset, size, False))
+                    self._enqueue_seq += 1
+                    self._enqueued.append(
+                        (self._enqueue_seq, w, p, offset, size, False)
+                    )
         for h in handles:
             Worker.finish_download(h)
 
@@ -719,35 +1096,83 @@ class Cores:
         )
 
     # -- enqueue-mode sync (reference: flushLastUsedCommandQueue / finish) ----
-    def flush(self) -> None:
-        """Read back and join everything deferred by enqueue mode."""
-        with self._lock:
-            pending, self._enqueued = self._enqueued, []
-        # keep the most recent record per (worker, array) — it reflects the
-        # latest device contents
-        latest: dict[tuple[int, int], int] = {}
-        for i, (w, p, _, _, _) in enumerate(pending):
-            latest[(id(w), id(p))] = i
-        # host writes land in CHRONOLOGICAL order: after a sync-point
-        # rebalance two workers' latest slices of one array can overlap
-        # (the grown chip recomputed a region the shrunk chip wrote
-        # earlier) — the newer record must be the one that sticks
+    @staticmethod
+    def _latest_records(pending) -> list[tuple]:
+        """Most recent record per (worker, array), in CHRONOLOGICAL order
+        (by sequence tag): after a sync-point rebalance two workers'
+        latest slices of one array can overlap (the grown chip recomputed
+        a region the shrunk chip wrote earlier) — the newer record must
+        be the one that sticks on the host."""
+        latest: dict[tuple[int, int], tuple] = {}
+        for rec in pending:
+            key = (id(rec[1]), id(rec[2]))
+            cur = latest.get(key)
+            if cur is None or rec[0] > cur[0]:
+                latest[key] = rec
+        return sorted(latest.values())
+
+    def _start_deferred_downloads(self, pending, lock_each: bool) -> list:
+        """Start async downloads for the newest record per (worker,
+        array) in chronological order — ONE code path for flush() (which
+        takes each worker's phase lock per record: another host thread's
+        lane may be mid-phase replacing buffer entries) and the atomic
+        rebalance flush (whose caller already holds every worker
+        lock)."""
         handles = []
-        for i in sorted(latest.values()):
-            w, p, offset, size, write_all = pending[i]
+        for _, w, p, offset, size, write_all in self._latest_records(pending):
             epw = p.flags.elements_per_work_item
-            # under the worker's phase lock: another host thread's lane may
-            # be mid-phase replacing this worker's buffer entries — reading
-            # them unlocked would hand back a pre-kernel buffer
-            with w.lock:
+            with (w.lock if lock_each else nullcontext()):
                 if write_all:
                     handles.append(w.download_async(p, 0, p.size, True))
                 else:
                     handles.append(
                         w.download_async(p, offset * epw, size * epw, False)
                     )
-        for h in handles:
+        return handles
+
+    def flush(self) -> None:
+        """Read back and join everything deferred by enqueue mode.  Any
+        open fused window is dispatched and drained first — the download
+        slices must see the post-ladder buffers."""
+        self._fused_close()
+        with self._lock:
+            pending, self._enqueued = self._enqueued, []
+        for h in self._start_deferred_downloads(pending, lock_each=True):
             Worker.finish_download(h)
+
+    def _flush_and_reset_coverage(self) -> None:
+        """The sync-point-rebalance flush: read back every deferred record
+        AND reset every chip's upload coverage as ONE atomic step under
+        ALL worker locks (the window-scoped coverage epoch the r7 KNOWN
+        LIMIT deferred).
+
+        Why atomicity matters: with several host threads enqueuing
+        different cids, a plain flush-then-reset lets another thread's
+        window launch between the flush's host writes and the coverage
+        reset — that thread's next covered-range check then re-uploads a
+        host copy missing its own just-launched increments (lost updates,
+        10-12/12 arrays on the 2-lane rig at seed).  Holding every worker
+        lock across [collect → download → host write → reset] makes the
+        interleaving structurally impossible: any launch sequenced before
+        the block has its record collected here (records are appended
+        under the worker lock), and any launch after the block sees reset
+        coverage AND a host already made current.  Each reset bumps
+        Worker.coverage_epoch, which in-flight fused windows check per
+        deferral (compute() breaks them with reason "non-resident").
+
+        Lock order is safe: no other path holds two worker locks, and
+        this thread takes the scheduler lock only nested inside (matching
+        _run_worker_locked's order)."""
+        self._fused_close()
+        with ExitStack() as stack:
+            for w in self.workers:
+                stack.enter_context(w.lock)
+            with self._lock:
+                pending, self._enqueued = self._enqueued, []
+            for h in self._start_deferred_downloads(pending, lock_each=False):
+                Worker.finish_download(h)
+            for w in self.workers:
+                w.reset_coverage()
 
     # -- reporting -----------------------------------------------------------
     def performance_report(self, compute_id: int | None = None) -> str:
@@ -802,7 +1227,15 @@ class Cores:
         id, at the cost of one extra ~RTT completion probe per id in
         the window; interleaved windows remain bounded by stream order
         (a cid's marginal includes earlier-dispatched work of
-        later-completing ids)."""
+        later-completing ids).
+
+        Fused windows close HERE: pending deferred iterations dispatch
+        (one ladder per device through the driver queues) and the drivers
+        drain before the fence, so the fence-retire time covers them —
+        window-granularity rebalance feedback, normalized to
+        per-iteration benches (balance.per_iteration_benches) so windows
+        of different sizes feed the balancer one scale."""
+        self._fused_close()
         t_b = TRACER.t0()
         t0 = self._enqueue_t0
         measure = self.enqueue_mode and t0 is not None and len(self.workers) > 1
@@ -841,15 +1274,23 @@ class Cores:
             if errs:
                 raise errs[0]
             if measure:
+                iters_map = dict(self._enqueue_iters)
                 for w in self.workers:
                     bench = (done_at[w.index] - t0) * 1000.0
                     splits = split_fence_benches(comp_at.get(w.index, ()), t0)
-                    for cid in self._enqueue_cids:
+                    window_ms = {
+                        cid: splits.get(cid, bench)
+                        for cid in self._enqueue_cids
                         # only chips that ran this id refresh its bench;
                         # split marginals when available, whole-window
                         # fence time otherwise (the documented default)
-                        if self.global_ranges.get(cid, [1] * len(self.workers))[w.index] > 0:
-                            w.benchmarks[cid] = splits.get(cid, bench)
+                        if self.global_ranges.get(
+                            cid, [1] * len(self.workers)
+                        )[w.index] > 0
+                    }
+                    w.benchmarks.update(
+                        per_iteration_benches(window_ms, iters_map)
+                    )
                 self._enqueue_rebalance |= self._enqueue_cids
             TRACER.record("fence", t_b, tag="barrier")
         finally:
@@ -864,6 +1305,7 @@ class Cores:
         with self._lock:
             self._enqueue_cids.clear()
             self._enqueue_cid_order.clear()
+            self._enqueue_iters.clear()
             self._enqueue_t0 = None
 
     def ranges_of(self, compute_id: int) -> list[int]:

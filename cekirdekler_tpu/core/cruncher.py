@@ -131,6 +131,43 @@ class NumberCruncher:
         self.cores.fence_split = bool(v)
 
     @property
+    def fused_dispatch(self) -> bool:
+        """Fused-iteration dispatch (default True): when an enqueue
+        window repeats the same compute id with unchanged partition
+        ranges and HBM-resident operands, calls after the first defer and
+        dispatch in batches as ONE dynamic-iteration-count ladder
+        executable per device — collapsing the per-call dispatch floor.
+        Results are bit-identical to per-iteration dispatch; disengages
+        are named in ``cores.fused_stats`` and as "fused" trace
+        instants (docs/PARALLELISM.md)."""
+        return self.cores.fused_dispatch
+
+    @fused_dispatch.setter
+    def fused_dispatch(self, v: bool) -> None:
+        if not v and self.cores.fused_dispatch:
+            # an open window must not outlive the toggle
+            self.cores._fused_close()
+        self.cores.fused_dispatch = bool(v)
+
+    @property
+    def fused_batch(self) -> int:
+        """Iterations per fused ladder dispatch (default 16): smaller
+        starts the device earlier in the window, larger amortizes the
+        dispatch floor over more iterations.  The executable is shared
+        across batch sizes (iteration count is a runtime argument)."""
+        return self.cores.fused_batch
+
+    @fused_batch.setter
+    def fused_batch(self, v: int) -> None:
+        self.cores.fused_batch = max(1, int(v))
+
+    @property
+    def fused_stats(self) -> dict:
+        """Fused-dispatch observability: windows dispatched, iterations
+        fused/deferred, and per-reason disengage counts."""
+        return self.cores.fused_stats
+
+    @property
     def smooth_load_balancer(self) -> bool:
         return self.cores.smooth_load_balancer
 

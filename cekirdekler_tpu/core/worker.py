@@ -21,10 +21,11 @@ NDRange offsets being launch parameters; ours are runtime scalars too).
 
 from __future__ import annotations
 
+import queue
 import threading
 import time
 from functools import partial
-from typing import Any, Sequence
+from typing import Any, Callable, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -87,6 +88,75 @@ def _ladder(size: int, step: int) -> list[int]:
     return out
 
 
+class _DriverQueue:
+    """Depth-limited per-device dispatch driver (the fused-iteration
+    path's host-side queue, core/cores.py): ONE daemon thread per chip
+    executes submitted dispatch closures strictly FIFO, so host-side
+    dispatch of device B's ladder overlaps device A's execution while
+    per-device ordering stays exact (a thread pool starts tasks in
+    submission order but two tasks for one device can still race on lock
+    acquisition).
+
+    ``depth`` (per :meth:`submit`, so a runtime retune of the caller's
+    knob takes effect immediately) bounds the in-flight closures (queued
+    + executing): a host running far ahead of device dispatch blocks in
+    :meth:`submit` — backpressure, not unbounded growth.  Closure
+    failures are held and re-raised at the next :meth:`drain` or
+    :meth:`submit` (a failed fused dispatch must surface at the window's
+    sync point, never masquerade as fast device work — the barrier()
+    error contract)."""
+
+    def __init__(self):
+        self._q: queue.Queue = queue.Queue()
+        self._cond = threading.Condition()
+        self._errors: list[Exception] = []
+        self._pending = 0
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def submit(self, fn: Callable[[], None], depth: int = 2) -> None:
+        with self._cond:
+            if self._errors:
+                e = self._errors[0]
+                self._errors.clear()
+                raise e
+            while self._pending >= max(1, int(depth)):
+                self._cond.wait()
+            self._pending += 1
+        self._q.put(fn)
+
+    def _run(self) -> None:
+        while True:
+            fn = self._q.get()
+            if fn is None:
+                return
+            try:
+                fn()
+            except Exception as e:  # noqa: BLE001 - re-raised at drain
+                with self._cond:
+                    self._errors.append(e)
+            finally:
+                with self._cond:
+                    self._pending -= 1
+                    self._cond.notify_all()
+
+    def drain(self) -> None:
+        """Block until every submitted closure has RUN (host-side
+        dispatch complete; device completion is the fence's business),
+        re-raising the first failure."""
+        with self._cond:
+            while self._pending > 0:
+                self._cond.wait()
+            if self._errors:
+                e = self._errors[0]
+                self._errors.clear()
+                raise e
+
+    def close(self) -> None:
+        self._q.put(None)
+        self._thread.join(timeout=5.0)
+
+
 class Worker:
     """Per-chip execution engine."""
 
@@ -128,6 +198,17 @@ class Worker:
         # cycles out, a cost only the split should pay.
         self.track_cid_outputs = False
         self._cid_last_out: dict[int, Any] = {}
+        # coverage epoch: bumped by every reset_coverage().  The fused
+        # dispatch path (core/cores.py) snapshots it at window engage and
+        # compares one int per deferral instead of re-walking per-array
+        # coverage records — any sync-point rebalance that reset this
+        # chip's coverage mid-window is detected and the fused run
+        # disengages instead of launching over ranges that now need a
+        # re-upload (the window-scoped coverage-epoch contract).
+        self.coverage_epoch = 0
+        # depth-limited per-device dispatch driver (fused path); lazy —
+        # workers outside the fused path never start the thread
+        self._driver: _DriverQueue | None = None
 
     # -- benchmarks ----------------------------------------------------------
     def start_bench(self, compute_id: int) -> None:
@@ -269,8 +350,28 @@ class Worker:
         the next enqueue-mode compute re-fetches its range from host.
         Called when a rebalance moves ranges — coverage records only ever
         grow, so a chip that lost a region and later re-acquires it would
-        otherwise skip the re-upload and read stale data."""
+        otherwise skip the re-upload and read stale data.  Bumps
+        :attr:`coverage_epoch` so an in-flight fused window observes the
+        reset and disengages (core/cores.py)."""
         self._uploaded.clear()
+        self.coverage_epoch += 1
+
+    # -- dispatch driver (fused path) ----------------------------------------
+    def dispatch_async(self, fn: Callable[[], None], depth: int = 2) -> None:
+        """Queue a dispatch closure on this chip's FIFO driver thread
+        (created lazily).  ``depth`` bounds the in-flight backlog PER
+        CALL — a runtime retune of the caller's knob applies to the next
+        submit, not only to the queue's creation."""
+        if self._driver is None:
+            self._driver = _DriverQueue()
+        self._driver.submit(fn, depth)
+
+    def drain_dispatch(self) -> None:
+        """Wait until every queued dispatch closure has run (host-side),
+        re-raising the first failure.  No-op when the driver never
+        started."""
+        if self._driver is not None:
+            self._driver.drain()
 
     # -- launch --------------------------------------------------------------
     def launch(
@@ -365,6 +466,66 @@ class Worker:
             self.markers.add(dispatched)
             self.markers.reach_when_ready(bufs[0], dispatched)
 
+    def launch_fused(
+        self,
+        program: KernelProgram,
+        kernel_names: Sequence[str],
+        params: Sequence[ClArray],
+        value_args: Sequence,
+        offset: int,
+        size: int,
+        local_range: int,
+        global_size: int,
+        step: int,
+        iters: int,
+        compute_id: int | None = None,
+    ) -> None:
+        """ONE dispatch running ``iters`` repetitions of the kernel
+        sequence over this chip's range — the fused-iteration ladder
+        (core/cores.py).  offset / units / iteration count are RUNTIME
+        arguments of one cached executable
+        (``KernelProgram.fused_launcher``), so the balancer re-splitting
+        or the window size changing never recompiles.  Buffers are
+        donated on TPU (state stays HBM-resident across iterations)
+        except while ``track_cid_outputs`` pins completion-probe buffers
+        other compute ids may still fence (``fence_cid`` on a donated
+        buffer would read a deleted array)."""
+        _tt = TRACER.t0()
+        donate = self.device.platform == "tpu" and not self.track_cid_outputs
+        fn = program.fused_launcher(
+            tuple(kernel_names), step, global_size, local_range,
+            global_size, value_args, platform=self.device.platform,
+            donate=donate,
+        )
+        if fn is None:  # unhashable values — caller gates on this
+            for _ in range(iters):
+                self.launch(
+                    program, kernel_names, params, value_args, offset,
+                    size, local_range, global_size, step,
+                    compute_id=compute_id,
+                )
+            return
+        bufs = tuple(self._buffers[id(p)] for p in params)
+        bufs = tuple(fn(offset, size // step, iters, bufs))
+        for p, b in zip(params, bufs):
+            self._buffers[id(p)] = b
+        if bufs:
+            if compute_id is not None and self.track_cid_outputs:
+                self._cid_last_out.pop(compute_id, None)
+                self._cid_last_out[compute_id] = bufs[0]
+                if len(self._cid_last_out) > 64:
+                    self._cid_last_out.pop(next(iter(self._cid_last_out)))
+            TRACER.record(
+                "launch", _tt, cid=compute_id, lane=self.index,
+                tag=f"fused:{'+'.join(kernel_names)} x{iters}",
+            )
+            if self.markers is not None:
+                # add AFTER the dispatch succeeded (launch()'s ordering):
+                # a failed dispatch must not leak an added-never-reached
+                # marker into the in-flight accounting
+                self.markers.add()
+                self.markers.reach_when_ready(bufs[0])
+
     # -- readback ------------------------------------------------------------
     def download_async(self, arr: ClArray, offset_elems: int, size_elems: int, full: bool):
         """D2H: start an async copy of this chip's range (or the full array);
@@ -451,6 +612,12 @@ class Worker:
         return True
 
     def dispose(self) -> None:
+        # driver first: a still-queued dispatch closure must finish (or
+        # fail into the driver's error slot) before the buffers it reads
+        # are cleared out from under it
+        if self._driver is not None:
+            self._driver.close()
+            self._driver = None
         self._buffers.clear()
         self._buffer_owner.clear()
         self._uploaded.clear()

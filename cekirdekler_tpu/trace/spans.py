@@ -38,7 +38,11 @@ Span kinds used by the built-in instrumentation (callers may add more):
 ``rebalance`` (the balancer moved shares), ``launch`` (kernel dispatch),
 ``fence`` (retirement wait), ``upload`` (H2D), ``download`` (D2H),
 ``pipeline-stage`` (one pipeline engine/stage body), ``pool-task``
-(device-pool task), ``dcn-exchange`` (cross-host collective).
+(device-pool task), ``dcn-exchange`` (cross-host collective), ``fused``
+(fused-iteration window flush — spans tag ``xK`` for a K-iteration
+ladder dispatch; zero-duration instants tag ``disengage:<reason>`` when
+the fused path falls back to per-iteration dispatch, so a silent perf
+regression to the slow path is attributable).
 """
 
 from __future__ import annotations
@@ -54,6 +58,7 @@ __all__ = ["Span", "Tracer", "TRACER", "SPAN_KINDS", "tracing"]
 SPAN_KINDS = (
     "enqueue", "split", "rebalance", "launch", "fence",
     "upload", "download", "pipeline-stage", "pool-task", "dcn-exchange",
+    "fused",
 )
 
 

@@ -60,11 +60,12 @@ def test_section_exception_recorded_not_raised():
 
 
 def test_reserved_sections_registered_in_bench():
-    # the two verdict-ordered sections AND the r6 acceptance-gate metric
-    # must stay must-run
+    # the two verdict-ordered sections AND the r6/r8 acceptance-gate
+    # metrics must stay must-run
     assert "dtype_matrix" in bench.RESERVED_SECTIONS
     assert "marker_overhead" in bench.RESERVED_SECTIONS
     assert "flash_train" in bench.RESERVED_SECTIONS
+    assert "dispatch_floor" in bench.RESERVED_SECTIONS
 
 
 def test_small_budget_override_still_runs_best_effort_sections():

@@ -1,0 +1,486 @@
+"""Fused-iteration dispatch path (the enqueue dispatch-floor collapse):
+deferral correctness, bit-identity with per-iteration dispatch, the
+executable-cache invariant across balancer re-partitioning, named
+disengage reasons, and the window-scoped coverage-epoch fix for the r7
+KNOWN LIMIT (multi-threaded enqueue windows + sync-point rebalance).
+
+The inc kernel adds exactly 1.0f — small-integer f32 arithmetic is exact,
+so every lost/duplicated iteration (and every lost REGION update across a
+range move) shows as an integer-sized error and the assertions can demand
+bit equality.  Value-varying math is covered by the mandelbrot and n-body
+bit-identity tests, which compare the fused path against the per-iteration
+path rather than against a host emulation."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from cekirdekler_tpu import ClArray
+from cekirdekler_tpu.core import NumberCruncher
+from cekirdekler_tpu.hardware import platforms
+
+INC = """
+__kernel void inc(__global float* a) {
+    int i = get_global_id(0);
+    a[i] = a[i] + 1.0f;
+}
+__kernel void dbl(__global float* a) {
+    int i = get_global_id(0);
+    a[i] = a[i] * 1.001f;
+}
+"""
+
+
+@pytest.fixture(scope="module")
+def devs():
+    return platforms().cpus()
+
+
+def laggy(orig, secs=0.2):
+    def f():
+        time.sleep(secs)
+        orig()
+
+    return f
+
+
+# ---------------------------------------------------------------------------
+# deferral + correctness
+# ---------------------------------------------------------------------------
+
+def test_fused_window_defers_and_is_exact(devs):
+    """An enqueue window repeating one cid defers everything after the
+    first call and still produces exactly the per-iteration result."""
+    cr = NumberCruncher(devs.subset(2), INC)
+    x = ClArray(np.zeros(1024, np.float32), name="x")
+    x.partial_read = True
+    cr.enqueue_mode = True
+    iters = 12
+    for _ in range(iters):
+        x.compute(cr, 1, "inc", 1024, 64)
+    # call 1 seeds the candidate, call 2 engages, calls 3..N defer
+    assert cr.fused_stats["deferred_iters"] == iters - 2, cr.fused_stats
+    # host untouched while deferred (enqueue semantics hold)
+    assert np.all(np.asarray(x) == 0.0)
+    cr.enqueue_mode = False  # flush dispatches the residue
+    assert cr.fused_stats["fused_iters"] == iters - 2
+    np.testing.assert_array_equal(np.asarray(x), float(iters))
+    cr.dispose()
+
+
+def test_fused_batches_dispatch_eagerly(devs):
+    """Deferral dispatches every fused_batch iterations (device starts
+    working mid-window), not only at the barrier."""
+    cr = NumberCruncher(devs.subset(2), INC)
+    cr.fused_batch = 4
+    x = ClArray(np.zeros(512, np.float32), name="x")
+    x.partial_read = True
+    cr.enqueue_mode = True
+    # call 1 seeds, call 2 engages, calls 3..11 defer -> 2 eager batches
+    # of 4 mid-window, residue 1 at the barrier
+    for _ in range(11):
+        x.compute(cr, 1, "inc", 512, 64)
+    assert cr.fused_stats["windows"] == 2
+    assert cr.fused_stats["fused_iters"] == 8
+    cr.barrier()  # residue (1) dispatches at the window close
+    assert cr.fused_stats["fused_iters"] == 9
+    cr.enqueue_mode = False
+    np.testing.assert_array_equal(np.asarray(x), 11.0)
+    cr.dispose()
+
+
+def test_fused_is_one_dispatch_per_batch(devs):
+    """Marker accounting: a 32-iteration window costs O(1) dispatches,
+    not O(iterations) — the dispatch-floor collapse made observable
+    (same methodology as test_repeat_is_one_fused_dispatch)."""
+    cr = NumberCruncher(devs.subset(1), INC)
+    cr.fine_grained_queue_control = True
+    cr.fused_batch = 32
+    x = ClArray(np.zeros(256, np.float32), name="x")
+    x.partial_read = True
+    cr.enqueue_mode = True
+    for _ in range(32):
+        x.compute(cr, 1, "inc", 256, 64)
+    cr.enqueue_mode = False
+    w = cr.cores.workers[0]
+    # 1 upload + 1 per-call launch + 1 fused ladder + 1 download = 4
+    assert w.markers.added <= 5, w.markers.added
+    np.testing.assert_array_equal(np.asarray(x), 32.0)
+    cr.dispose()
+
+
+def test_fused_bit_identical_mandelbrot_image(devs):
+    """The acceptance gate: the fused path's mandelbrot image is
+    BIT-identical to the per-iteration path's."""
+    from cekirdekler_tpu.workloads import MANDELBROT_SRC
+
+    w = h = 256
+    n = w * h
+    vals = (-2.0, -1.25, 2.5 / w, 2.5 / h, w, 64)
+    images = {}
+    for fused in (False, True):
+        cr = NumberCruncher(devs.subset(2), MANDELBROT_SRC)
+        cr.fused_dispatch = fused
+        out = ClArray(n, np.float32, name=f"m{fused}", read=False, write=True)
+        cr.enqueue_mode = True
+        for _ in range(5):
+            out.compute(cr, 31, "mandelbrot", n, 256, values=vals)
+        cr.enqueue_mode = False
+        if fused:
+            assert cr.fused_stats["fused_iters"] > 0
+        else:
+            assert cr.fused_stats["fused_iters"] == 0
+        images[fused] = np.asarray(out).copy()
+        cr.dispose()
+    np.testing.assert_array_equal(images[True], images[False])
+
+
+def test_fused_bit_identical_accumulating_nbody(devs):
+    """Accumulating state (the n-body velocity integral): K fused
+    iterations equal K per-iteration dispatches bit-for-bit."""
+    from cekirdekler_tpu.workloads import NBODY_SRC, _nbody_rig
+
+    n, iters = 512, 8
+    results = {}
+    for fused in (False, True):
+        _, (x, y, z), vel = _nbody_rig(n, f"f{int(fused)}")
+        cr = NumberCruncher(devs.subset(2), NBODY_SRC)
+        cr.fused_dispatch = fused
+        g = x.next_param(y, z, *vel)
+        cr.enqueue_mode = True
+        for _ in range(iters):
+            g.compute(cr, 32, "nBody", n, 64, values=(n, 1e-4))
+        cr.enqueue_mode = False
+        results[fused] = [np.asarray(v).copy() for v in vel]
+        cr.dispose()
+    for a, b in zip(results[True], results[False]):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_fused_mixed_cids_and_fence_split(devs):
+    """Alternating cids breaks fusion per switch (signature-change) but
+    stays exact; fence_split's per-cid completion probes survive the
+    fused launches (donation is disabled while probes are pinned)."""
+    cr = NumberCruncher(devs.subset(2), INC)
+    cr.fence_split = True
+    x = ClArray(np.zeros(512, np.float32), name="x")
+    x.partial_read = True
+    y = ClArray(np.ones(512, np.float32), name="y")
+    y.partial_read = True
+    cr.enqueue_mode = True
+    for _ in range(3):
+        for _ in range(4):
+            x.compute(cr, 41, "inc", 512, 64)
+        for _ in range(4):
+            y.compute(cr, 42, "dbl", 512, 64)
+    cr.barrier()
+    cr.enqueue_mode = False
+    dis = cr.fused_stats["disengaged"]
+    assert dis.get("signature-change", 0) >= 5, dis
+    assert cr.fused_stats["fused_iters"] > 0
+    np.testing.assert_array_equal(np.asarray(x), 12.0)
+    np.testing.assert_allclose(
+        np.asarray(y), np.float32(1.001) ** 12, rtol=1e-5
+    )
+    cr.dispose()
+
+
+# ---------------------------------------------------------------------------
+# executable-cache keying (satellite: compile-count invariant)
+# ---------------------------------------------------------------------------
+
+def test_fused_executable_cache_survives_rebalance(devs):
+    """Compile count stays FLAT across a forced rebalance (range shift,
+    unchanged shapes) and the fused executable count increments exactly
+    once on a genuine shape change — the executable-cache keying
+    contract (offset/units/iteration-count are runtime arguments of one
+    cached ladder)."""
+    cr = NumberCruncher(devs.subset(2), INC)
+    prog = cr.cores.program
+    x = ClArray(np.zeros(4096, np.float32), name="x")
+    x.partial_read = True
+    cr.enqueue_mode = True
+    slow = cr.cores.workers[0]
+    orig_fence = slow.fence
+    total = 0
+    try:
+        for _ in range(3):
+            x.compute(cr, 51, "inc", 4096, 64)
+            total += 1
+        cr.barrier()
+        warm_fused = prog.fused_compiled_count
+        warm_total = prog.compiled_count
+        assert warm_fused == 1
+        # force a genuine range shift: the slow chip must lose share
+        slow.fence = laggy(orig_fence)
+        for _ in range(3):
+            x.compute(cr, 51, "inc", 4096, 64)
+            total += 1
+        cr.barrier()
+        slow.fence = orig_fence
+        before_move = cr.ranges_of(51)
+        for _ in range(3):  # first call rebalances (armed), then re-fuses
+            x.compute(cr, 51, "inc", 4096, 64)
+            total += 1
+        cr.barrier()
+        moved = cr.ranges_of(51)
+        assert moved != before_move, (before_move, moved)
+        # the invariant: re-partitioning hit the cache, no recompile —
+        # neither a new fused ladder nor any new per-chunk geometry
+        assert prog.fused_compiled_count == warm_fused
+        assert prog.compiled_count == warm_total
+        # a genuine shape change compiles exactly one new fused ladder
+        y = ClArray(np.zeros(8192, np.float32), name="y")
+        y.partial_read = True
+        for _ in range(3):
+            y.compute(cr, 52, "inc", 8192, 64)
+        cr.barrier()  # fused build happens at the window dispatch
+        assert prog.fused_compiled_count == warm_fused + 1
+    finally:
+        slow.fence = orig_fence
+        cr.enqueue_mode = False
+    np.testing.assert_array_equal(np.asarray(x), float(total))
+    cr.dispose()
+
+
+# ---------------------------------------------------------------------------
+# named disengage reasons (satellite: no silent fallback)
+# ---------------------------------------------------------------------------
+
+def _tracer_disengages():
+    from cekirdekler_tpu.trace.spans import TRACER
+
+    return [
+        s.tag for s in TRACER.snapshot()
+        if s.kind == "fused" and (s.tag or "").startswith("disengage:")
+    ]
+
+
+def test_disengage_range_change_is_named(devs):
+    """An armed rebalance (range change at the window boundary) breaks
+    the fused run with reason "range-change" — and emits a trace
+    instant."""
+    from cekirdekler_tpu.trace.spans import TRACER
+
+    cr = NumberCruncher(devs.subset(2), INC)
+    x = ClArray(np.zeros(4096, np.float32), name="x")
+    x.partial_read = True
+    cr.enqueue_mode = True
+    slow = cr.cores.workers[0]
+    orig = slow.fence
+    slow.fence = laggy(orig)
+    TRACER.enable(clear=True)
+    try:
+        for _ in range(3):
+            x.compute(cr, 61, "inc", 4096, 64)
+        cr.barrier()  # arms the rebalance
+        slow.fence = orig
+        # sig from the new window's first call matches nothing (window
+        # closed at the barrier), so re-engage, then defer, then break on
+        # the SECOND window boundary?  No: the armed flag is consumed by
+        # the first call after the barrier — which therefore cannot have
+        # an active fused sig.  Drive one engage + one armed break:
+        x.compute(cr, 61, "inc", 4096, 64)  # armed rebalance consumed here
+        x.compute(cr, 61, "inc", 4096, 64)  # defers
+        cr.cores._enqueue_rebalance.add(61)  # re-arm mid-window (as a
+        # concurrent thread's barrier would)
+        x.compute(cr, 61, "inc", 4096, 64)  # breaks: range-change
+        assert cr.fused_stats["disengaged"].get("range-change", 0) == 1
+        assert any("range-change" in t for t in _tracer_disengages())
+    finally:
+        TRACER.disable()
+        slow.fence = orig
+        cr.enqueue_mode = False
+    np.testing.assert_array_equal(np.asarray(x), 6.0)
+    cr.dispose()
+
+
+def test_disengage_non_resident_is_named(devs):
+    """A coverage-epoch bump mid-window (what every reset_coverage()
+    does) disengages with reason "non-resident" and results stay exact."""
+    cr = NumberCruncher(devs.subset(2), INC)
+    x = ClArray(np.zeros(1024, np.float32), name="x")
+    x.partial_read = True
+    cr.enqueue_mode = True
+    for _ in range(3):
+        x.compute(cr, 62, "inc", 1024, 64)
+    assert cr.cores._fused_sig is not None
+    for w in cr.cores.workers:
+        w.coverage_epoch += 1  # the observable effect of reset_coverage()
+    x.compute(cr, 62, "inc", 1024, 64)
+    assert cr.fused_stats["disengaged"].get("non-resident", 0) == 1
+    cr.enqueue_mode = False
+    np.testing.assert_array_equal(np.asarray(x), 4.0)
+    cr.dispose()
+
+
+def test_disengage_pipeline_and_repeat_are_named(devs):
+    """Pipelined enqueue calls and repeat-mode calls refuse fusion with
+    their own reasons (each already fuses internally or blobs)."""
+    cr = NumberCruncher(devs.subset(2), INC)
+    x = ClArray(np.zeros(2048, np.float32), name="x")
+    x.partial_read = True
+    cr.enqueue_mode = True
+    x.compute(cr, 63, "inc", 2048, 64)  # seeds
+    x.compute(cr, 63, "inc", 2048, 64)  # engages
+    x.compute(cr, 63, "inc", 2048, 64, pipeline=True, pipeline_blobs=4)
+    assert cr.fused_stats["disengaged"].get("pipeline", 0) >= 1
+    cr.repeat_count = 3
+    x.compute(cr, 63, "inc", 2048, 64)  # refused while repeat-mode is on
+    assert cr.fused_stats["disengaged"].get("repeat-mode", 0) >= 1
+    cr.repeat_count = 1
+    cr.enqueue_mode = False
+    np.testing.assert_array_equal(np.asarray(x), 6.0)
+    cr.dispose()
+
+
+def test_disengage_mode_change_mid_window(devs):
+    """Runtime mode toggles are NOT in the window signature — flipping
+    one mid-window must break the run ("mode-change"), not defer a call
+    whose semantics changed.  repeat_count=3 mid-window must apply 3
+    on-device repeats (deferred, it would count as ONE); no_compute_mode
+    mid-window must skip compute entirely; a dispatch_gate must hold."""
+    cr = NumberCruncher(devs.subset(2), INC)
+    x = ClArray(np.zeros(512, np.float32), name="x")
+    x.partial_read = True
+    cr.enqueue_mode = True
+    x.compute(cr, 66, "inc", 512, 64)  # engages
+    x.compute(cr, 66, "inc", 512, 64)  # defers
+    cr.repeat_count = 3
+    x.compute(cr, 66, "inc", 512, 64)  # 3 repeats, must NOT defer as 1
+    assert cr.fused_stats["disengaged"].get("mode-change", 0) == 1
+    cr.repeat_count = 1
+    x.compute(cr, 66, "inc", 512, 64)  # re-engages
+    x.compute(cr, 66, "inc", 512, 64)  # defers
+    cr.no_compute_mode = True
+    x.compute(cr, 66, "inc", 512, 64)  # I/O only, must NOT defer
+    assert cr.fused_stats["disengaged"].get("mode-change", 0) == 2
+    cr.no_compute_mode = False
+    cr.enqueue_mode = False
+    np.testing.assert_array_equal(np.asarray(x), 7.0)  # 1+1+3+1+1+0
+    cr.dispose()
+
+
+def test_disengage_partial_upload_guard(devs):
+    """The engage-time coverage guard: a read param whose chip range is
+    not fully covered refuses engagement with reason "partial-upload"
+    (unit-level: the builtin upload path leaves ranges covered, so the
+    refusal is rigged via a shrunk coverage record)."""
+    cr = NumberCruncher(devs.subset(2), INC)
+    cores = cr.cores
+    x = ClArray(np.zeros(1024, np.float32), name="x")
+    x.partial_read = True
+    cr.enqueue_mode = True
+    x.compute(cr, 64, "inc", 1024, 64)  # seeds the candidate
+    x.compute(cr, 64, "inc", 1024, 64)  # consecutive repeat -> engages
+    assert cores._fused_sig is not None
+    cores._fused_close()
+    w = cores.workers[0]
+    with w.lock:
+        off, _ = w._uploaded[id(x)]
+        w._uploaded[id(x)] = (off, 1)
+    cores._fused_try_engage(
+        ["inc"], [x], 64, 1024, 64, 0, (),
+        cores.global_ranges[64], cores.global_references[64], 64,
+    )
+    assert cores._fused_sig is None
+    assert cr.fused_stats["disengaged"].get("partial-upload", 0) == 1
+    cr.enqueue_mode = False
+    cr.dispose()
+
+
+def test_disengage_unhashable_values(devs):
+    """Unhashable value args cannot bake into the fused executable —
+    refusal reason "unhashable-values", per-iteration results exact."""
+    src = """
+    __kernel void axb(__global float* x, float aa) {
+        int i = get_global_id(0);
+        x[i] = x[i] + aa;
+    }"""
+    cr = NumberCruncher(devs.subset(2), src)
+    x = ClArray(np.zeros(256, np.float32), name="x")
+    x.partial_read = True
+    cr.enqueue_mode = True
+
+    class UnhashableFloat(float):
+        __hash__ = None
+
+    for _ in range(3):
+        x.compute(cr, 65, "axb", 256, 64, values=(UnhashableFloat(2.0),))
+    assert cr.fused_stats["disengaged"].get("unhashable-values", 0) >= 1
+    assert cr.fused_stats["fused_iters"] == 0
+    cr.enqueue_mode = False
+    np.testing.assert_array_equal(np.asarray(x), 6.0)
+    cr.dispose()
+
+
+# ---------------------------------------------------------------------------
+# the r7 KNOWN LIMIT: multi-threaded windows + sync-point rebalance
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("fused", [False, True])
+def test_threaded_enqueue_windows_no_lost_updates(devs, fused):
+    """Regression for the KNOWN LIMIT the r7 trace hammer surfaced (lost
+    updates, 10-12/12 arrays at seed): one thread drives barriers + armed
+    rebalances (its chip share forced to oscillate) while another thread
+    enqueues a different cid through the same Cores.  The armed
+    rebalance's flush+reset must be atomic against the other thread's
+    in-flight window — exact final values on BOTH arrays, with the fused
+    path on and off (off reproduces the seed code shape)."""
+    cr = NumberCruncher(devs.subset(2), INC)
+    cr.fused_dispatch = fused
+    n = 4096
+    x = ClArray(np.zeros(n, np.float32), name="x")  # thread B's array
+    x.partial_read = True
+    y = ClArray(np.zeros(n, np.float32), name="y")  # thread A's array
+    y.partial_read = True
+    cr.enqueue_mode = True
+    w0, w1 = cr.cores.workers
+    f0, f1 = w0.fence, w1.fence
+    phases = 6
+    per_phase_a = 2
+    errors: list = []
+    b_iters = 0
+    stop = threading.Event()
+
+    def thread_a():
+        # alternate which chip lags so the armed rebalance MOVES ranges
+        # (flush+reset fires on thread A's next compute each phase)
+        try:
+            for ph in range(phases):
+                slow, orig = (w0, f0) if ph % 2 == 0 else (w1, f1)
+                slow.fence = laggy(orig, 0.15)
+                for _ in range(per_phase_a):
+                    y.compute(cr, 71, "inc", n, 64)
+                cr.barrier()
+                w0.fence, w1.fence = f0, f1
+        except Exception as e:  # pragma: no cover - failure path
+            errors.append(e)
+        finally:
+            w0.fence, w1.fence = f0, f1
+            stop.set()
+
+    def thread_b():
+        nonlocal b_iters
+        try:
+            while not stop.is_set() and b_iters < 400:
+                x.compute(cr, 72, "inc", n, 64)
+                b_iters += 1
+        except Exception as e:  # pragma: no cover - failure path
+            errors.append(e)
+
+    ta = threading.Thread(target=thread_a)
+    tb = threading.Thread(target=thread_b)
+    ta.start()
+    tb.start()
+    ta.join(timeout=120.0)
+    tb.join(timeout=120.0)
+    assert not errors, errors
+    cr.enqueue_mode = False
+    # +1.0f on small integers is exact in f32: ANY lost iteration (or a
+    # lost region update across a range move) is an integer-sized error
+    np.testing.assert_array_equal(np.asarray(x), float(b_iters))
+    np.testing.assert_array_equal(np.asarray(y), float(phases * per_phase_a))
+    cr.dispose()

@@ -1,0 +1,64 @@
+"""Dispatch-floor microbench CLI: sweep enqueue-window size K and print
+the per-dispatch overhead, per-iteration vs FUSED dispatch, from trace
+spans (the measurement behind ISSUE 3's "collapse the enqueue dispatch
+floor"; methodology in ``workloads.dispatch_floor_sweep``).
+
+Run on the target chip from the repo root:
+
+    python tools/dispatch_floor.py [--ks 1,8,32,128] [--n 16384]
+                                   [--reps 3] [--json]
+
+Per row: window wall, barrier-fence cost, derived per-dispatch
+milliseconds, and the tracer's own launch-span count — the K → K/batch
+dispatch-count evidence.  ``--json`` prints the raw artifact (one JSON
+line, bench.py's ``dispatch_floor`` section emits the same structure).
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--ks", default="1,8,32,128",
+                    help="comma-separated window sizes")
+    ap.add_argument("--n", type=int, default=1 << 14,
+                    help="light-kernel array length")
+    ap.add_argument("--reps", type=int, default=3,
+                    help="windows per row (best kept)")
+    ap.add_argument("--local", type=int, default=256, help="local range")
+    ap.add_argument("--json", action="store_true",
+                    help="print the raw JSON artifact only")
+    args = ap.parse_args()
+
+    from cekirdekler_tpu.workloads import dispatch_floor_sweep
+
+    ks = tuple(int(k) for k in args.ks.split(","))
+    out = dispatch_floor_sweep(
+        ks=ks, n=args.n, local_range=args.local, reps=args.reps
+    )
+    if args.json:
+        print(json.dumps(out))
+        return
+    print(out["note"])
+    hdr = (f"{'mode':>10} {'K':>5} {'wall ms':>10} {'fence ms':>10} "
+           f"{'per-dispatch ms':>16} {'launches':>9} {'fused wins':>10}")
+    print(hdr)
+    for r in out["rows"]:
+        print(
+            f"{'fused' if r['fused'] else 'per-iter':>10} {r['K']:>5} "
+            f"{r['wall_ms']:>10.3f} {r['fence_ms']:>10.3f} "
+            f"{r['per_dispatch_ms']:>16.4f} {r['launch_spans']:>9} "
+            f"{r['fused_windows']:>10}"
+        )
+    if "floor_collapse_at_kmax" in out:
+        print(f"floor collapse at K={max(ks)}: "
+              f"{out['floor_collapse_at_kmax']}x")
+
+
+if __name__ == "__main__":
+    main()
