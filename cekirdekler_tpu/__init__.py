@@ -19,6 +19,7 @@ from .errors import (
     KernelLanguageError,
 )
 from .hardware import AcceleratorType, Device, Devices, Platform, Platforms, all_devices, platforms
+from . import metrics  # always-on health registry (docs/OBSERVABILITY.md)
 from . import trace  # span-based attribution (docs/OBSERVABILITY.md)
 
 __version__ = "0.1.0"
@@ -42,6 +43,7 @@ __all__ = [
     "TransferFlags",
     "all_devices",
     "platforms",
+    "metrics",
     "trace",
     "wrap",
 ]

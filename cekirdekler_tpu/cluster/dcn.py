@@ -44,6 +44,7 @@ from ..arrays.clarray import ClArray, ParameterGroup
 from ..core.cruncher import NumberCruncher
 from ..errors import CekirdeklerError, ComputeValidationError
 from ..hardware import Device, Devices
+from ..metrics.registry import REGISTRY
 from ..trace.spans import TRACER
 from .accelerator import IComputeNode
 from .balancer import ClusterLoadBalancer
@@ -190,6 +191,7 @@ class DistributedAccelerator(IComputeNode):
         from jax.sharding import PartitionSpec as P
 
         _tt = TRACER.t0()
+        _t0 = time.perf_counter()
         value = np.ascontiguousarray(value)
         raw = value.view(np.uint8)
         mesh = _process_mesh()
@@ -200,6 +202,14 @@ class DistributedAccelerator(IComputeNode):
             (nproc,) + raw.shape, NamedSharding(mesh, P("x")), [shard]
         )
         gathered = np.asarray(_replicator(mesh)(garr))
+        REGISTRY.counter(
+            "ck_dcn_exchange_bytes_total", "bytes moved over DCN collectives",
+            op="allgather",
+        ).inc(raw.nbytes * nproc)
+        REGISTRY.histogram(
+            "ck_dcn_exchange_seconds", "per-collective wall latency",
+            op="allgather",
+        ).observe(time.perf_counter() - _t0)
         TRACER.record(
             "dcn-exchange", _tt, tag=f"allgather {raw.nbytes}B x{nproc}"
         )
@@ -223,6 +233,7 @@ class DistributedAccelerator(IComputeNode):
         from jax.sharding import PartitionSpec as P
 
         _tt = TRACER.t0()
+        _t0 = time.perf_counter()
         value = np.ascontiguousarray(value)
         raw = value.view(np.uint8)
         mesh = _process_mesh()
@@ -233,6 +244,14 @@ class DistributedAccelerator(IComputeNode):
             (nproc,) + raw.shape, NamedSharding(mesh, P("x")), [shard]
         )
         out = np.asarray(_reducer(mesh)(garr))
+        REGISTRY.counter(
+            "ck_dcn_exchange_bytes_total", "bytes moved over DCN collectives",
+            op="broadcast0",
+        ).inc(raw.nbytes)
+        REGISTRY.histogram(
+            "ck_dcn_exchange_seconds", "per-collective wall latency",
+            op="broadcast0",
+        ).observe(time.perf_counter() - _t0)
         TRACER.record(
             "dcn-exchange", _tt, tag=f"broadcast0 {raw.nbytes}B"
         )

@@ -24,6 +24,8 @@ from __future__ import annotations
 
 from dataclasses import dataclass, field
 
+from ..metrics.registry import REGISTRY
+
 __all__ = [
     "load_balance",
     "BalanceHistory",
@@ -210,6 +212,10 @@ def load_balance(
                     history.smooth(shares)
                 state.cont = [float(r) for r in ranges]
                 state.prev_delta = [0.0] * n
+                REGISTRY.counter(
+                    "ck_balance_freeze_total",
+                    "quantization-floor freezes (split held, churn avoided)",
+                ).inc()
                 return list(ranges)
 
     # 3: optional smoothing
@@ -239,6 +245,10 @@ def load_balance(
         if s > 0:
             cont = [c * (total / s) for c in cont]
         state.cont = list(cont)
+        REGISTRY.gauge(
+            "ck_balance_damp_mean",
+            "mean adaptive per-chip damping (carry state health)",
+        ).set(sum(state.damp) / n)
     else:
         cont = [base[i] - (base[i] - total * shares[i]) * damping for i in range(n)]
     if carry is not None:

@@ -37,6 +37,7 @@ from ..arrays.clarray import ClArray
 from ..errors import ComputeValidationError
 from ..hardware import Devices
 from ..kernel.registry import KernelProgram
+from ..metrics.registry import REGISTRY
 from ..trace.attribution import split_fence_benches
 from ..trace.spans import TRACER
 from .balance import (
@@ -181,11 +182,27 @@ class Cores:
         self._fused_mu = threading.Lock()
         # observability: windows dispatched, iterations fused, and every
         # disengage with its named reason — a perf regression to the
-        # per-iteration path must be attributable, never silent
+        # per-iteration path must be attributable, never silent.  The
+        # dict stays as the per-cruncher API (tests and nbody_e2e read
+        # it); the metrics registry carries the same counts process-wide
+        # (ck_fused_* series) for the uniform Prometheus/artifact export.
         self.fused_stats: dict[str, Any] = {
             "windows": 0, "fused_iters": 0, "deferred_iters": 0,
             "disengaged": {},
         }
+        # cached metric handles for the fused hot/warm paths: the
+        # deferral IS the dispatch-floor collapse ("a counter
+        # increment"), so it must not pay a registry get-or-create per
+        # call (label-less here; the per-reason disengage counter stays
+        # get-or-create — disengages are cold)
+        self._m_fused_deferred = REGISTRY.counter(
+            "ck_fused_deferred_iters_total",
+            "enqueue calls deferred into fused windows")
+        self._m_fused_windows = REGISTRY.counter(
+            "ck_fused_windows_total", "fused ladder dispatch batches")
+        self._m_fused_iters = REGISTRY.counter(
+            "ck_fused_iters_total",
+            "iterations dispatched via fused ladders")
         # per-cid fence splitting (VERDICT r5 #8): when on, barrier()
         # fences each compute id's last output in last-dispatch order and
         # feeds the balancer MARGINAL per-cid times instead of charging
@@ -419,6 +436,25 @@ class Cores:
                 "split" if not old_ranges else "rebalance",
                 cid=compute_id, tag=str(ranges),
             )
+            # balancer health (metrics registry): per-cid per-device share
+            # gauges set on CHANGE only (steady state costs nothing), the
+            # re-split count, and how many work items the move shifted
+            REGISTRY.counter(
+                "ck_rebalance_total", "range-table changes",
+                cid=compute_id,
+            ).inc()
+            if old_ranges and len(old_ranges) == len(ranges):
+                moved = sum(
+                    abs(a - b) for a, b in zip(ranges, old_ranges)) // 2
+                REGISTRY.counter(
+                    "ck_rebalance_moved_items_total",
+                    "work items shifted between chips by rebalances",
+                ).inc(moved)
+            for i, r in enumerate(ranges):
+                REGISTRY.gauge(
+                    "ck_balance_share", "per-chip work-item share",
+                    cid=compute_id, lane=i,
+                ).set(r)
         if self.enqueue_mode and old_ranges and ranges != old_ranges:
             # the balancer moved shares between syncs: host arrays must be
             # made current BEFORE any chip uploads its newly-acquired region
@@ -638,10 +674,7 @@ class Cores:
                 # to repeat — the deferral contract (pure launch) fails
                 reason = "partial-upload"
         if reason is not None:
-            with self._lock:
-                d = self.fused_stats["disengaged"]
-                d[reason] = d.get(reason, 0) + 1
-            TRACER.instant("fused", cid=compute_id, tag=f"disengage:{reason}")
+            self._note_disengage(reason, compute_id)
             return
         run = _FusedRun(
             sig=sig, compute_id=compute_id,
@@ -666,6 +699,7 @@ class Cores:
             self._fused_pending += 1
             pending = self._fused_pending
             self.fused_stats["deferred_iters"] += 1
+        self._m_fused_deferred.inc()
         if pending >= max(1, int(self.fused_batch)):
             self._fused_flush()
         TRACER.record(
@@ -711,6 +745,8 @@ class Cores:
         with self._lock:
             self.fused_stats["windows"] += 1
             self.fused_stats["fused_iters"] += iters
+        self._m_fused_windows.inc()
+        self._m_fused_iters.inc(iters)
         TRACER.record("fused", _tt, cid=run.compute_id, tag=f"x{iters}")
 
     def _fused_flush(self) -> None:
@@ -739,6 +775,21 @@ class Cores:
                 self._dispatch_fused(run, k)
         self._fused_drain()
 
+    def _note_disengage(self, reason: str, cid: int | None) -> None:
+        """The one disengage-accounting path: fused_stats dict bump +
+        ck_fused_disengage_total{reason} + "fused" trace instant (the
+        dict and the registry are documented as carrying the same
+        counts — one code path keeps them from drifting)."""
+        with self._lock:
+            d = self.fused_stats["disengaged"]
+            d[reason] = d.get(reason, 0) + 1
+        REGISTRY.counter(
+            "ck_fused_disengage_total",
+            "fused-window refusals/breaks by named reason",
+            reason=reason,
+        ).inc()
+        TRACER.instant("fused", cid=cid, tag=f"disengage:{reason}")
+
     def _fused_break(self, reason: str) -> None:
         """_fused_close plus the disengage bookkeeping: the named reason
         lands in fused_stats and as a "fused" trace instant."""
@@ -746,10 +797,7 @@ class Cores:
             run = self._fused_run
         cid = run.compute_id if run is not None else None
         self._fused_close()
-        with self._lock:
-            d = self.fused_stats["disengaged"]
-            d[reason] = d.get(reason, 0) + 1
-        TRACER.instant("fused", cid=cid, tag=f"disengage:{reason}")
+        self._note_disengage(reason, cid)
 
     def _fused_drain(self) -> None:
         errs: list[Exception] = []
@@ -1005,6 +1053,10 @@ class Cores:
                     epw = fl.elements_per_work_item
                     handles.append(w.download_async(p, boff * epw, blob * epw, False))
         self._pipeline_epilogue(w, params, offset, size, write_all_owner, handles)
+        REGISTRY.counter(
+            "ck_pipeline_stages_total", "stage bodies executed",
+            engine="DRIVER",
+        ).inc()
         TRACER.record(
             "pipeline-stage", _tt, cid=compute_id, lane=w.index,
             tag=f"DRIVER x{blobs}",
@@ -1090,6 +1142,10 @@ class Cores:
                     epw = p.flags.elements_per_work_item
                     handles.append(w.download_async(p, boff * epw, blob * epw, False))
         self._pipeline_epilogue(w, params, offset, size, write_all_owner, handles)
+        REGISTRY.counter(
+            "ck_pipeline_stages_total", "stage bodies executed",
+            engine="EVENT",
+        ).inc()
         TRACER.record(
             "pipeline-stage", _tt, cid=compute_id, lane=w.index,
             tag=f"EVENT x{blobs} look{look}",
@@ -1236,6 +1292,10 @@ class Cores:
         per-iteration benches (balance.per_iteration_benches) so windows
         of different sizes feed the balancer one scale."""
         self._fused_close()
+        REGISTRY.counter(
+            "ck_barriers_total", "enqueue-window sync points",
+        ).inc()
+        _mt0 = time.perf_counter()
         t_b = TRACER.t0()
         t0 = self._enqueue_t0
         measure = self.enqueue_mode and t0 is not None and len(self.workers) > 1
@@ -1294,6 +1354,9 @@ class Cores:
                 self._enqueue_rebalance |= self._enqueue_cids
             TRACER.record("fence", t_b, tag="barrier")
         finally:
+            REGISTRY.histogram(
+                "ck_barrier_seconds", "barrier wall time",
+            ).observe(time.perf_counter() - _mt0)
             # always close the window — a fence failure must not leave a
             # stale t0/cid set to corrupt the NEXT window's benches
             self._enqueue_window_closed()

@@ -34,6 +34,7 @@ from jax import lax
 
 from ..arrays.clarray import ClArray
 from ..kernel.registry import KernelProgram
+from ..metrics.registry import REGISTRY
 from ..trace.spans import TRACER
 from ..utils.markers import MarkerCounter
 
@@ -106,11 +107,14 @@ class _DriverQueue:
     sync point, never masquerade as fast device work — the barrier()
     error contract)."""
 
-    def __init__(self):
+    def __init__(self, depth_gauge=None):
         self._q: queue.Queue = queue.Queue()
         self._cond = threading.Condition()
         self._errors: list[Exception] = []
         self._pending = 0
+        # driver-FIFO occupancy gauge (metrics registry): queued +
+        # executing closures, the fused path's host-side backlog
+        self._depth_gauge = depth_gauge
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
 
@@ -123,6 +127,8 @@ class _DriverQueue:
             while self._pending >= max(1, int(depth)):
                 self._cond.wait()
             self._pending += 1
+            if self._depth_gauge is not None:
+                self._depth_gauge.set(self._pending)
         self._q.put(fn)
 
     def _run(self) -> None:
@@ -138,6 +144,8 @@ class _DriverQueue:
             finally:
                 with self._cond:
                     self._pending -= 1
+                    if self._depth_gauge is not None:
+                        self._depth_gauge.set(self._pending)
                     self._cond.notify_all()
 
     def drain(self) -> None:
@@ -209,6 +217,21 @@ class Worker:
         # depth-limited per-device dispatch driver (fused path); lazy —
         # workers outside the fused path never start the thread
         self._driver: _DriverQueue | None = None
+        # always-on health metrics (metrics/registry.py): transfer bytes,
+        # fence waits, driver occupancy — handles cached here because the
+        # lane label is static for the worker's lifetime
+        self._m_upload_bytes = REGISTRY.counter(
+            "ck_upload_bytes_total", "H2D bytes uploaded", lane=index)
+        self._m_download_bytes = REGISTRY.counter(
+            "ck_download_bytes_total", "D2H bytes materialized", lane=index)
+        self._m_fence_waits = REGISTRY.counter(
+            "ck_fence_waits_total", "whole-lane retirement fences",
+            lane=index)
+        self._m_fence_seconds = REGISTRY.histogram(
+            "ck_fence_seconds", "fence wait duration", lane=index)
+        self._m_driver_depth = REGISTRY.gauge(
+            "ck_driver_queue_depth", "fused-dispatch driver FIFO occupancy",
+            lane=index)
 
     # -- benchmarks ----------------------------------------------------------
     def start_bench(self, compute_id: int) -> None:
@@ -241,14 +264,19 @@ class Worker:
             try:
                 x = jnp.from_dlpack(host_slice)
                 if self.device in x.devices():
+                    # aliased, not copied: ZERO bytes moved — counting
+                    # host_slice.nbytes here would report full H2D
+                    # traffic for runs that transferred nothing
                     self.last_upload_path = "dlpack-zero-copy"
                 else:
                     x = jax.device_put(x, self.device)
                     self.last_upload_path = "dlpack+move"
+                    self._m_upload_bytes.inc(host_slice.nbytes)
                 return x
             except Exception:
                 pass  # backend can't alias host memory — stage instead
         self.last_upload_path = "staged-dma"
+        self._m_upload_bytes.inc(host_slice.nbytes)
         # numpy → target device directly: wrapping in jnp.asarray first
         # would land on the default device and force a cross-device copy
         return jax.device_put(host_slice, self.device)
@@ -363,7 +391,7 @@ class Worker:
         CALL — a runtime retune of the caller's knob applies to the next
         submit, not only to the queue's creation."""
         if self._driver is None:
-            self._driver = _DriverQueue()
+            self._driver = _DriverQueue(self._m_driver_depth)
         self._driver.submit(fn, depth)
 
     def drain_dispatch(self) -> None:
@@ -543,11 +571,12 @@ class Worker:
             out.copy_to_host_async()
         except Exception:
             pass
-        return (arr, out, off, self.markers, self.index)
+        return (arr, out, off, self.markers, self.index,
+                self._m_download_bytes)
 
     @staticmethod
     def finish_download(handle) -> None:
-        arr, out, off, markers, lane = handle
+        arr, out, off, markers, lane, byte_counter = handle
         _tt = TRACER.t0()
         host = arr.host()
         data = np.asarray(out)
@@ -571,6 +600,7 @@ class Worker:
             )
         else:
             view[:] = data
+        byte_counter.inc(data.nbytes)
         TRACER.record("download", _tt, lane=lane, tag=arr.name)
         if markers is not None:
             markers.reach()
@@ -591,7 +621,10 @@ class Worker:
             bufs = [b for b in self._buffers.values() if b.size]
         if not bufs:
             return
+        t0 = time.perf_counter()
         np.asarray(_fence_probe(bufs))
+        self._m_fence_waits.inc()
+        self._m_fence_seconds.observe(time.perf_counter() - t0)
 
     def fence_cid(self, compute_id: int) -> bool:
         """Block until this chip's work for ONE compute id has retired:

@@ -34,6 +34,7 @@ import numpy as np
 
 from ..arrays.clarray import ClArray, wrap
 from ..errors import CekirdeklerError, ComputeValidationError
+from ..metrics.registry import REGISTRY
 from ..hardware import Device
 from ..kernel.registry import KernelProgram
 from ..trace.spans import TRACER
@@ -194,6 +195,10 @@ class PipelineStage:
         for s, b in zip(slots, bufs):
             s.value = b
         self.elapsed_ms = (time.perf_counter() - t0) * 1000.0
+        REGISTRY.counter(
+            "ck_pipeline_stages_total", "stage bodies executed",
+            engine="single",
+        ).inc()
         TRACER.record(
             "pipeline-stage", _tt,
             tag=f"{self.device.name if self.device else '?'}:"
@@ -227,6 +232,10 @@ class PipelineStage:
         for s in self.outputs + self.transitions:
             s.value = s.arr.host()
         self.elapsed_ms = (time.perf_counter() - t0) * 1000.0
+        REGISTRY.counter(
+            "ck_pipeline_stages_total", "stage bodies executed",
+            engine="multi",
+        ).inc()
         TRACER.record(
             "pipeline-stage", _tt,
             tag=f"multi[{len(self.devices) if self.devices else 0}]:"

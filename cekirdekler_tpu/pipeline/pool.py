@@ -37,6 +37,7 @@ from ..arrays.clarray import ClArray, ParameterGroup
 from ..core.cruncher import NumberCruncher
 from ..errors import CekirdeklerError
 from ..hardware import Device, Devices
+from ..metrics.registry import REGISTRY
 from ..trace.spans import TRACER
 
 __all__ = ["ClTaskType", "ClTask", "ClTaskPool", "ClDevicePool", "PoolType"]
@@ -212,6 +213,10 @@ class _Consumer(threading.Thread):
                         "pool-task", _tt, cid=task.compute_id,
                         lane=self.index, tag=f"task{task.task_id}",
                     )
+                    REGISTRY.counter(
+                        "ck_pool_tasks_total", "device-pool tasks completed",
+                        lane=self.index,
+                    ).inc()
                     self.tasks_done += 1
                     if task.callback is not None:
                         task.callback(task)

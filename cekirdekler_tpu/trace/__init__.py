@@ -1,7 +1,7 @@
 """``cekirdekler_tpu.trace`` — span-based attribution: explain every
 lost millisecond.
 
-Four pieces (see ``docs/OBSERVABILITY.md`` for the guided tour):
+Five pieces (see ``docs/OBSERVABILITY.md`` for the guided tour):
 
 - :mod:`.spans` — the process-global :data:`TRACER`: a lock-free-ish
   ring buffer of typed spans (enqueue, split, rebalance, launch, fence,
@@ -17,11 +17,22 @@ Four pieces (see ``docs/OBSERVABILITY.md`` for the guided tour):
 - :mod:`.ceiling` — the overlap ceiling re-derived from same-rep duplex
   probes with a witness clamp, so ``achieved_vs_ceiling`` is a real
   ratio-to-a-bound (≤ 1 structurally) with per-rep spread.
+- :mod:`.aggregate` — cluster-wide aggregation: DCN worker processes
+  ship span batches + metric snapshots with RTT-symmetric clock-offset
+  estimation, producing ONE merged, alignment-checked Perfetto trace
+  for an N-process job.
 
 None of these import jax at module level: enabling tracing costs no
 backend initialization.
 """
 
+from .aggregate import (
+    ClusterSnapshot,
+    collective_consistency,
+    estimate_clock_offsets,
+    gather_cluster,
+    merged_chrome_trace,
+)
 from .attribution import AttributionReport, split_fence_benches, window_report
 from .ceiling import RepSample, ceiling_report, rep_ceiling
 from .export import (
@@ -34,13 +45,18 @@ from .spans import SPAN_KINDS, TRACER, Span, Tracer, tracing
 
 __all__ = [
     "AttributionReport",
+    "ClusterSnapshot",
     "RepSample",
     "SPAN_KINDS",
     "Span",
     "TRACER",
     "Tracer",
     "ceiling_report",
+    "collective_consistency",
+    "estimate_clock_offsets",
     "from_chrome_trace",
+    "gather_cluster",
+    "merged_chrome_trace",
     "rep_ceiling",
     "save_chrome_trace",
     "split_fence_benches",

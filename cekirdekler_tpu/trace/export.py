@@ -32,28 +32,48 @@ def _tid(lane: int | None) -> int:
 
 
 def to_chrome_trace(
-    spans: Sequence[Span], process_name: str = "cekirdekler_tpu"
+    spans: Sequence[Span],
+    process_name: str = "cekirdekler_tpu",
+    counters: dict | None = None,
+    pid: int = _PID,
+    t_base: float | None = None,
 ) -> dict:
     """Spans → Chrome trace dict (``{"traceEvents": [...]}``).
 
     ``ts`` is microseconds relative to the earliest span so the viewer
-    opens at t=0 instead of hours into a perf_counter epoch."""
+    opens at t=0 instead of hours into a perf_counter epoch.
+
+    ``counters`` (``metrics.REGISTRY.counter_series()`` output: series
+    name → [(perf_counter, value), ...]) adds Perfetto **counter
+    tracks** to the same timeline — balancer shares, driver-queue
+    occupancy, transfer byte counters ride next to the spans that
+    explain them.  ``pid``/``t_base`` exist for the cluster aggregator
+    (``trace/aggregate.py``), which emits one process block per DCN
+    process against one shared clock origin."""
     spans = list(spans)
-    t_base = min((s.t0 for s in spans), default=0.0)
+    if t_base is None:
+        # counter samples participate in the origin: with zero spans a
+        # 0.0 base would place ph:C events at absolute perf_counter
+        # microseconds (hours past t=0 in the viewer)
+        candidates = [s.t0 for s in spans]
+        for series in (counters or {}).values():
+            if series:
+                candidates.append(series[0][0])
+        t_base = min(candidates, default=0.0)
     events: list[dict] = [
         {
-            "ph": "M", "name": "process_name", "pid": _PID, "tid": 0,
+            "ph": "M", "name": "process_name", "pid": pid, "tid": 0,
             "args": {"name": process_name},
         },
         {
-            "ph": "M", "name": "thread_name", "pid": _PID, "tid": 0,
+            "ph": "M", "name": "thread_name", "pid": pid, "tid": 0,
             "args": {"name": "host"},
         },
     ]
     lanes = sorted({s.lane for s in spans if s.lane is not None})
     for lane in lanes:
         events.append({
-            "ph": "M", "name": "thread_name", "pid": _PID, "tid": _tid(lane),
+            "ph": "M", "name": "thread_name", "pid": pid, "tid": _tid(lane),
             "args": {"name": f"lane {lane}"},
         })
     for s in spans:
@@ -66,12 +86,16 @@ def to_chrome_trace(
             "ph": "X",
             "name": s.kind,
             "cat": "ck",
-            "pid": _PID,
+            "pid": pid,
             "tid": _tid(s.lane),
             "ts": (s.t0 - t_base) * 1e6,
             "dur": (s.t1 - s.t0) * 1e6,
             "args": args,
         })
+    if counters:
+        from ..metrics.export import chrome_counter_events
+
+        events.extend(chrome_counter_events(counters, t_base, pid=pid))
     return {"traceEvents": events, "displayTimeUnit": "ms"}
 
 
@@ -99,11 +123,12 @@ def from_chrome_trace(trace: dict) -> list[Span]:
 
 
 def save_chrome_trace(
-    spans: Sequence[Span], path: str, process_name: str = "cekirdekler_tpu"
+    spans: Sequence[Span], path: str, process_name: str = "cekirdekler_tpu",
+    counters: dict | None = None,
 ) -> str:
     """Write the Chrome trace JSON; returns ``path`` for chaining."""
     with open(path, "w") as f:
-        json.dump(to_chrome_trace(spans, process_name), f)
+        json.dump(to_chrome_trace(spans, process_name, counters=counters), f)
     return path
 
 

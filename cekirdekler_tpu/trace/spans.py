@@ -216,7 +216,8 @@ TRACER = Tracer()
 
 
 @contextmanager
-def tracing(capacity: int | None = None, keep: bool = False):
+def tracing(capacity: int | None = None, keep: bool = False,
+            metrics: bool = False):
     """Scoped enable of the global tracer::
 
         with trace.tracing() as tr:
@@ -224,12 +225,30 @@ def tracing(capacity: int | None = None, keep: bool = False):
         report = attribution.window_report(tr.snapshot(), t0, t1)
 
     Disables on exit; spans survive (``keep`` preserves pre-existing
-    spans instead of clearing on entry)."""
+    spans instead of clearing on entry).  ``metrics=True`` additionally
+    turns on registry sampling for the window
+    (``metrics.REGISTRY.enable_sampling``), so the counter time series
+    for Perfetto counter tracks cover exactly the traced window::
+
+        with trace.tracing(metrics=True) as tr:
+            ...work...
+        trace.save_chrome_trace(
+            tr.snapshot(), path,
+            counters=metrics.REGISTRY.counter_series())
+    """
     TRACER.enable(capacity=capacity, clear=not keep)
+    if metrics:
+        from ..metrics.registry import REGISTRY as _REG
+
+        _REG.enable_sampling()
     try:
         yield TRACER
     finally:
         TRACER.disable()
+        if metrics:
+            from ..metrics.registry import REGISTRY as _REG
+
+            _REG.disable_sampling()
 
 
 def spans_by_kind(spans: Iterable[Span]) -> dict[str, list[Span]]:

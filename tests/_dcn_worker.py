@@ -14,7 +14,17 @@ Verifies, from inside the job:
   design argument rests on supporting unequal device counts; the
   asymmetric job is what actually exercises it),
 - the LCM-step balancer moved work away from the (deterministically)
-  slow process.
+  slow process,
+- cluster aggregation (trace/aggregate.py): each process records spans
+  under the tracer and per-process DCN metrics; `gather_cluster` with a
+  DELIBERATE per-process clock skew (pid x 7.5 s — simulating the
+  distinct monotonic epochs real multi-host jobs have, which a
+  one-machine rig cannot produce naturally) must estimate and cancel
+  the skew: the merged trace's cross-process `dcn-exchange` spans stay
+  collective-consistent (every process's k-th collective overlaps every
+  other's after alignment), the merged Perfetto dict carries one
+  process block per DCN process, and process 0 receives every
+  process's metric snapshot (nonzero exchange-byte counters).
 """
 
 import math
@@ -53,6 +63,9 @@ def main(pid: int, nproc: int, port: int, counts: list[int]) -> None:
     hook = lambda cid, share, wall: float(share) * (3.0 if pid == 1 else 1.0)
     acc = DistributedAccelerator(timing_hook=hook)
     try:
+        from cekirdekler_tpu.trace.spans import TRACER
+
+        TRACER.enable(clear=True)  # record dcn-exchange spans to aggregate
         acc.setup_nodes(SRC)
         # the agreed device-count table IS the asymmetry evidence
         assert acc.proc_device_counts == counts, acc.proc_device_counts
@@ -99,6 +112,50 @@ def main(pid: int, nproc: int, port: int, counts: list[int]) -> None:
         mine = np.arange(5, dtype=np.float64) + (100.0 if pid == 0 else -7.0)
         got = acc._broadcast0(mine)
         assert got.dtype == np.float64 and got[0] == 100.0, got
+
+        # ---- cluster aggregation: one merged timeline for the job ----
+        from cekirdekler_tpu.metrics.registry import REGISTRY
+        from cekirdekler_tpu.trace import aggregate
+
+        spans = TRACER.snapshot()
+        TRACER.disable()
+        assert any(s.kind == "dcn-exchange" for s in spans), (
+            [s.kind for s in spans][:10])
+        # deliberate per-process clock skew (seconds — orders of
+        # magnitude above the collectives' ms-scale durations): the
+        # offset estimator must recover and cancel it, or the
+        # consistency margin below goes hugely negative
+        skew = pid * 7.5
+        snap = aggregate.gather_cluster(acc, spans=spans, skew_s=skew)
+        assert snap["nproc"] == nproc
+        assert abs(snap["offsets"][0]) < 1e-9, snap["offsets"]
+        # every process shipped nonzero DCN metrics to the collector
+        for p in range(nproc):
+            counters = snap["metrics"][p]["counters"]
+            xbytes = sum(
+                v for k, v in counters.items()
+                if k.startswith("ck_dcn_exchange_bytes_total")
+            )
+            assert xbytes > 0, (p, counters)
+        # cross-process monotonic consistency after alignment: each
+        # collective's spans must mutually overlap.  Loopback gloo RTTs
+        # are sub-ms and the probe error bound is RTT/2 per process;
+        # 250 ms slack covers scheduler noise on a shared rig while
+        # still catching an uncancelled skew (>= 7.5 s) 30x over.
+        margin = aggregate.collective_consistency(snap)
+        assert margin > -0.25, f"merged trace inconsistent: {margin}"
+        merged = aggregate.merged_chrome_trace(snap)
+        pids = {e["pid"] for e in merged["traceEvents"]}
+        assert pids == set(range(1, nproc + 1)), pids
+        if pid == 0:
+            import json as _json
+            import tempfile
+
+            path = os.path.join(tempfile.gettempdir(), "ck_dcn_merged.json")
+            with open(path, "w") as f:
+                _json.dump(merged, f)
+            print(f"DCN_MERGED pid=0 events={len(merged['traceEvents'])} "
+                  f"margin={margin:.4f} path={path}", flush=True)
         print(f"DCN_OK pid={pid} final={final}", flush=True)
     finally:
         acc.dispose()

@@ -1,0 +1,133 @@
+#!/usr/bin/env python
+"""Pretty-print a metrics-registry snapshot: live (drive a small
+instrumented workload in this process), or from a bench artifact's
+embedded ``metrics`` block.
+
+The registry is process-local, so "live" means THIS process: with
+``--demo`` the tool runs a short enqueue-window workload on the virtual
+CPU rig (2 chips, a few windows, a rebalance) and dumps the registry
+the runtime populated — the quickest way to see every ``ck_*`` series a
+real run produces.  Without ``--demo`` it prints whatever the current
+process registered (empty unless you import this from instrumented
+code).
+
+Usage::
+
+    python tools/metrics_dump.py --demo            # table
+    python tools/metrics_dump.py --demo --prom     # Prometheus text
+    python tools/metrics_dump.py --demo --json     # JSON snapshot
+    python tools/metrics_dump.py --from-artifact BENCH_r06.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _demo() -> None:
+    """A few enqueue windows on the 2-chip virtual rig — populates the
+    balancer, worker, fused, and barrier series."""
+    import numpy as np
+
+    from cekirdekler_tpu import ClArray, all_devices
+    from cekirdekler_tpu.core.cruncher import NumberCruncher
+
+    src = """
+    __kernel void saxpy(__global float* x, __global float* y, float a) {
+        int i = get_global_id(0);
+        y[i] = y[i] + a * x[i];
+    }
+    """
+    devs = all_devices().cpus()
+    cr = NumberCruncher(devs.subset(min(2, len(devs))), src)
+    try:
+        n = 4096
+        x = ClArray(np.arange(n, dtype=np.float32), partial_read=True,
+                    read_only=True)
+        y = ClArray(np.ones(n, np.float32), partial_read=True)
+        cr.enqueue_mode = True
+        for _ in range(2):
+            for _ in range(8):
+                x.next_param(y).compute(cr, 1, "saxpy", n, 64, values=(2.0,))
+            cr.barrier()
+        cr.enqueue_mode = False
+    finally:
+        cr.dispose()
+
+
+def _table(snapshot: dict) -> str:
+    lines = []
+    for kind in ("counters", "gauges"):
+        block = snapshot.get(kind) or {}
+        if block:
+            lines.append(f"-- {kind}")
+            w = max(len(k) for k in block)
+            for k in sorted(block):
+                lines.append(f"  {k:<{w}}  {block[k]}")
+    hists = snapshot.get("histograms") or {}
+    if hists:
+        lines.append("-- histograms")
+        for k in sorted(hists):
+            v = hists[k]
+            mean = v["sum"] / v["count"] if v["count"] else 0.0
+            lines.append(
+                f"  {k}  count={v['count']} sum={v['sum']:.6g} "
+                f"mean={mean:.6g}"
+            )
+    return "\n".join(lines) if lines else "(registry empty)"
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--prom", action="store_true",
+                    help="Prometheus exposition format")
+    ap.add_argument("--json", action="store_true", help="JSON snapshot")
+    ap.add_argument("--demo", action="store_true",
+                    help="run a short instrumented rig workload first")
+    ap.add_argument("--from-artifact", default=None,
+                    help="print the metrics block embedded in a bench "
+                         "artifact instead of the live registry")
+    args = ap.parse_args(argv)
+
+    if args.from_artifact:
+        with open(args.from_artifact) as f:
+            doc = json.load(f)
+        snap = doc.get("metrics")
+        if snap is None and isinstance(doc.get("parsed"), dict):
+            snap = doc["parsed"].get("metrics")
+        if snap is None:
+            print("no metrics block in artifact", file=sys.stderr)
+            return 1
+        if args.prom:
+            # the SAME renderer as the live path, so an artifact
+            # re-render is label-for-label comparable to a scrape
+            from cekirdekler_tpu.metrics import prometheus_from_snapshot
+
+            sys.stdout.write(prometheus_from_snapshot(snap))
+        elif args.json:
+            print(json.dumps(snap, indent=2, sort_keys=True))
+        else:
+            print(_table(snap))
+        return 0
+
+    if args.demo:
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        _demo()
+    from cekirdekler_tpu.metrics import REGISTRY, prometheus_text
+
+    if args.prom:
+        sys.stdout.write(prometheus_text())
+    elif args.json:
+        print(json.dumps(REGISTRY.snapshot(), indent=2, sort_keys=True))
+    else:
+        print(_table(REGISTRY.snapshot()))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,407 @@
+#!/usr/bin/env python
+"""Bench regression sentinel: diff the ``BENCH_r*.json`` trajectory on
+headline keys and fail LOUDLY on silent regressions and starved
+sections.
+
+The failure mode this closes (ISSUE 4): the bench starved a promised
+section two rounds running and nothing noticed — a ``null`` in the
+artifact reads the same as "never promised".  And a headline number can
+drop 30% between rounds with no gate anywhere.  This tool is that gate:
+
+- **Headline diffs, noise-aware.**  Each watched key carries a
+  direction and a relative-tolerance floor; when >= 3 historical
+  artifacts carry the key, the tolerance widens to ``NOISE_K`` x the
+  trajectory's coefficient of variation (tunnel link weather drifts
+  some keys 2x day-to-day — a fixed 10% gate would cry wolf; a key
+  that's historically stable keeps the tight floor).
+- **null is a verdict, not a shrug.**  A watched key that the baseline
+  carries but the candidate nulls is a HARD failure, with the section
+  scheduler's starvation reason attached (bench.py writes
+  ``{"null_reason": ..., "budget_spent_s": ...}`` records and an
+  ``errors`` map — both are searched).
+- **Artifact-format tolerant.**  Driver artifacts are
+  ``{"n", "cmd", "rc", "tail", "parsed"}`` where ``tail`` holds only
+  the LAST 2000 chars of output; the headline block prints last
+  precisely so it survives that truncation — ``extract_tail_object``
+  recovers ``headline``/``errors`` from the truncated tail by balanced-
+  brace scanning.  Raw ``bench.py`` output lines and already-parsed
+  dicts load too.
+
+Exit codes: 0 = healthy, 2 = headline regression, 3 = starved/null
+watched key (both nonzero — CI gates on any nonzero).
+
+Usage::
+
+    python tools/regress.py --against BENCH_r05.json [--candidate F]
+    python tools/regress.py --against BENCH_r05.json --json
+
+With no ``--candidate``, the newest ``BENCH_r*.json`` other than
+``--against`` is the candidate.  ``bench.py`` also runs this in-process
+as an epilogue (:func:`bench_epilogue`) so every fresh artifact carries
+its own verdict against the previous round.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+__all__ = [
+    "WATCHED_KEYS",
+    "extract_tail_object",
+    "load_headline",
+    "diff_headlines",
+    "bench_epilogue",
+    "main",
+]
+
+#: (headline key, aliases in older rounds, direction, rel-tol floor).
+#: Direction "higher" = bigger is better; a drop beyond tolerance is a
+#: regression (improvements never fail).
+WATCHED_KEYS = (
+    ("flash_T8192_mfu_default", (), "higher", 0.10),
+    ("flash_T8192_speedup_highest", (), "higher", 0.15),
+    ("nbody_e2e_enqueue_gpairs", ("nbody_e2e_gpairs",), "higher", 0.15),
+    ("dispatch_floor_collapse", (), "higher", 0.20),
+    ("mandelbrot_mpix", (), "higher", 0.10),
+    ("vs_tuned_loop", (), "higher", 0.10),
+    ("repeat_mode_mpix", (), "higher", 0.10),
+)
+
+#: Trajectory-noise widening: tolerance = max(floor, NOISE_K * CV).
+NOISE_K = 2.0
+
+#: headline key -> bench section whose starvation reason explains a null
+KEY_SECTION = {
+    "flash_T8192_mfu_default": "flash_train",
+    "flash_T8192_speedup_highest": "flash_train",
+    "nbody_e2e_enqueue_gpairs": "nbody_e2e",
+    "nbody_e2e_gpairs": "nbody_e2e",
+    "dispatch_floor_collapse": "dispatch_floor",
+    "dtype_cells": "dtype_matrix",
+    "mandelbrot_mpix": "framework",
+    "vs_tuned_loop": "tuned_loop",
+    "repeat_mode_mpix": "repeat_mode",
+}
+
+
+def extract_tail_object(text: str, key: str) -> dict | None:
+    """Recover the LAST ``"key": {...}`` object from possibly-truncated
+    JSON text by balanced-brace scanning (string-aware).  Returns None
+    when the key or a complete object isn't there."""
+    pat = re.compile(r'"%s"\s*:\s*\{' % re.escape(key))
+    last = None
+    for m in pat.finditer(text):
+        last = m
+    if last is None:
+        return None
+    i = last.end() - 1  # the opening brace
+    depth = 0
+    in_str = False
+    esc = False
+    for j in range(i, len(text)):
+        ch = text[j]
+        if in_str:
+            if esc:
+                esc = False
+            elif ch == "\\":
+                esc = True
+            elif ch == '"':
+                in_str = False
+            continue
+        if ch == '"':
+            in_str = True
+        elif ch == "{":
+            depth += 1
+        elif ch == "}":
+            depth -= 1
+            if depth == 0:
+                try:
+                    return json.loads(text[i : j + 1])
+                except json.JSONDecodeError:
+                    return None
+    return None
+
+
+def load_headline(path: str) -> dict:
+    """Load one artifact (driver wrapper, raw bench line, or parsed
+    dict) → ``{"headline": ..., "errors": ..., "null_sections": ...,
+    "sections": raw-or-None, "path": ...}``.  Missing pieces come back
+    None, never raise.  ``null_sections`` is bench.py's compact
+    section → ``{"null_reason", "budget_spent_s"}`` map, emitted just
+    before the headline precisely so it survives the driver's
+    2000-char tail truncation."""
+    out = {"path": path, "headline": None, "errors": None,
+           "null_sections": None, "sections": None}
+    try:
+        with open(path) as f:
+            text = f.read()
+    except OSError as e:
+        out["errors"] = {"_load": f"{type(e).__name__}: {e}"}
+        return out
+    doc = None
+    try:
+        doc = json.loads(text)
+    except json.JSONDecodeError:
+        pass
+    if isinstance(doc, dict) and "headline" in doc:
+        # a raw bench.py result line
+        out["headline"] = doc.get("headline")
+        out["errors"] = doc.get("errors")
+        out["null_sections"] = doc.get("null_sections")
+        out["sections"] = doc
+        return out
+    if isinstance(doc, dict) and "tail" in doc:
+        parsed = doc.get("parsed")
+        if isinstance(parsed, dict) and parsed.get("headline") is not None:
+            out["headline"] = parsed.get("headline")
+            out["errors"] = parsed.get("errors")
+            out["null_sections"] = parsed.get("null_sections")
+            out["sections"] = parsed
+            return out
+        text = doc.get("tail") or ""
+    # truncated tail (or unknown shape): recover the trailing objects
+    out["headline"] = extract_tail_object(text, "headline")
+    out["errors"] = extract_tail_object(text, "errors")
+    out["null_sections"] = extract_tail_object(text, "null_sections")
+    return out
+
+
+def _get(headline: dict | None, key: str, aliases=()) -> float | None:
+    if not isinstance(headline, dict):
+        return None
+    for k in (key, *aliases):
+        v = headline.get(k)
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            return float(v)
+    return None
+
+
+def _null_reason(candidate: dict, key: str) -> str:
+    """Best starvation/failure reason the candidate artifact offers for
+    a missing watched key: the tail-surviving ``null_sections`` map
+    first, then the section's own annotated record, then ``errors``."""
+    section = KEY_SECTION.get(key)
+    if not section:
+        return "no reason recorded in artifact"
+    for source in (candidate.get("null_sections"), candidate.get("sections")):
+        if isinstance(source, dict):
+            rec = source.get(section)
+            if isinstance(rec, dict) and rec.get("null_reason"):
+                spent = rec.get("budget_spent_s")
+                return f"{rec['null_reason']} (budget_spent_s={spent})"
+    errors = candidate.get("errors")
+    if isinstance(errors, dict) and section in errors:
+        return str(errors[section])
+    return "no reason recorded in artifact"
+
+
+def _trajectory_cv(history: list[dict], key: str, aliases=()) -> float | None:
+    vals = [v for v in (_get(h, key, aliases) for h in history)
+            if v is not None]
+    if len(vals) < 3:
+        return None
+    mean = sum(vals) / len(vals)
+    if mean == 0:
+        return None
+    var = sum((v - mean) ** 2 for v in vals) / (len(vals) - 1)
+    return (var ** 0.5) / abs(mean)
+
+
+def diff_headlines(
+    baseline: dict,
+    candidate: dict,
+    history: list[dict] | None = None,
+    watched=WATCHED_KEYS,
+) -> dict:
+    """The sentinel's core: compare two loaded artifacts
+    (:func:`load_headline` output) on the watched headline keys.
+
+    Returns ``{"ok", "exit_code", "findings": [...], "checked": N}``
+    with one finding per violated key — kind "regression" (beyond
+    noise-aware tolerance) or "starved" (baseline had it, candidate
+    nulls it, reason attached)."""
+    findings: list[dict] = []
+    checked = 0
+    base_h, cand_h = baseline.get("headline"), candidate.get("headline")
+    if not isinstance(cand_h, dict):
+        return {
+            "ok": False, "exit_code": 3, "checked": 0,
+            "findings": [{
+                "kind": "starved", "key": "headline",
+                "reason": "candidate artifact carries no headline block "
+                          "at all (bench died before the tail-survival "
+                          "block printed)",
+            }],
+        }
+    for key, aliases, direction, floor in watched:
+        base_v = _get(base_h, key, aliases)
+        if base_v is None:
+            continue  # nothing to regress against
+        checked += 1
+        cand_v = _get(cand_h, key, aliases)
+        if cand_v is None:
+            findings.append({
+                "kind": "starved", "key": key, "baseline": base_v,
+                "reason": _null_reason(candidate, key),
+            })
+            continue
+        tol = floor
+        cv = _trajectory_cv(
+            [h.get("headline") or {} for h in (history or [])],
+            key, aliases,
+        )
+        if cv is not None:
+            tol = max(floor, NOISE_K * cv)
+        if direction == "higher":
+            drop = (base_v - cand_v) / abs(base_v) if base_v else 0.0
+        else:
+            drop = (cand_v - base_v) / abs(base_v) if base_v else 0.0
+        if drop > tol:
+            findings.append({
+                "kind": "regression", "key": key,
+                "baseline": base_v, "candidate": cand_v,
+                "drop_frac": round(drop, 4), "tolerance": round(tol, 4),
+            })
+    starved = any(f["kind"] == "starved" for f in findings)
+    regressed = any(f["kind"] == "regression" for f in findings)
+    code = 3 if starved else (2 if regressed else 0)
+    return {
+        "ok": code == 0, "exit_code": code, "checked": checked,
+        "findings": findings,
+    }
+
+
+def _round_key(path: str):
+    """Numeric round ordering: lexicographic basenames misorder r99 vs
+    r100 (and unpadded names), which would gate a fresh artifact
+    against the wrong round."""
+    m = re.search(r"BENCH_r(\d+)", os.path.basename(path))
+    return (int(m.group(1)) if m else -1, os.path.basename(path))
+
+
+def _artifact_paths(root: str) -> list[str]:
+    return sorted(glob.glob(os.path.join(root, "BENCH_r*.json")),
+                  key=_round_key)
+
+
+def bench_epilogue(result: dict, repo_root: str) -> dict | None:
+    """In-process sentinel pass for a fresh ``bench.py`` result: diff
+    its headline against the newest on-disk artifact (the previous
+    round), with the whole trajectory as the noise model.  Returns the
+    verdict dict (embedded in the result) or None when there is no
+    prior artifact.  Never raises — the bench's one-JSON-line contract
+    outranks the sentinel."""
+    try:
+        paths = _artifact_paths(repo_root)
+        if not paths:
+            return None
+        history = [load_headline(p) for p in paths]
+        # newest artifact WITH a recoverable headline: a truncated/
+        # crashed previous round must not silently disable the sentinel
+        # (diff_headlines only hard-fails a headline-less CANDIDATE; a
+        # headline-less baseline would check 0 keys and report ok:true)
+        baseline = next(
+            (h for h in reversed(history)
+             if isinstance(h.get("headline"), dict)), None)
+        if baseline is None:
+            return {
+                "ok": None,
+                "error": "no on-disk artifact carries a recoverable "
+                         "headline — nothing to gate against",
+            }
+        candidate = {
+            "path": "<this run>", "headline": result.get("headline"),
+            "errors": result.get("errors"),
+            "null_sections": result.get("null_sections"),
+            "sections": result,
+        }
+        verdict = diff_headlines(baseline, candidate, history=history)
+        verdict["against"] = os.path.basename(baseline["path"])
+        return verdict
+    except Exception as e:  # noqa: BLE001 - resilience boundary
+        return {"ok": None, "error": f"{type(e).__name__}: {e}"[:300]}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--against", required=True,
+                    help="baseline artifact (e.g. BENCH_r05.json)")
+    ap.add_argument("--candidate", default=None,
+                    help="candidate artifact or raw bench output "
+                         "(default: newest BENCH_r*.json != --against)")
+    ap.add_argument("--json", action="store_true",
+                    help="print the verdict as JSON")
+    ap.add_argument("--root", default=None,
+                    help="directory holding the BENCH_r*.json trajectory "
+                         "(default: the repo root)")
+    args = ap.parse_args(argv)
+
+    root = args.root or os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))
+    baseline = load_headline(args.against)
+    if baseline["headline"] is None:
+        print(f"regress: no headline in baseline {args.against}",
+              file=sys.stderr)
+        return 1
+    cand_path = args.candidate
+    if cand_path is None:
+        # only artifacts NEWER than the baseline qualify: picking an
+        # older round would diff time-backwards (improvements would
+        # read as regressions and vice versa).  A baseline outside the
+        # BENCH_r<N> naming has no round to compare against — require
+        # an explicit candidate rather than letting the -1 fallback key
+        # mark every artifact "newer"
+        if not re.search(r"BENCH_r(\d+)", os.path.basename(args.against)):
+            print(
+                f"regress: baseline {args.against} does not follow "
+                "BENCH_r<N> naming — pass --candidate explicitly",
+                file=sys.stderr,
+            )
+            return 1
+        newer = [
+            p for p in _artifact_paths(root)
+            if _round_key(p) > _round_key(args.against)
+        ]
+        if not newer:
+            print(
+                f"regress: no artifact newer than {args.against} — pass "
+                "--candidate explicitly", file=sys.stderr,
+            )
+            return 1
+        cand_path = newer[-1]
+    candidate = load_headline(cand_path)
+    # the candidate must NOT feed the noise model: a regressed artifact
+    # would inflate the trajectory CV and widen its own tolerance
+    # (verified failure mode: a 30% drop masking itself)
+    history = [
+        load_headline(p) for p in _artifact_paths(root)
+        if os.path.abspath(p) != os.path.abspath(cand_path)
+    ]
+    verdict = diff_headlines(baseline, candidate, history=history)
+    verdict["against"] = args.against
+    verdict["candidate"] = cand_path
+    if args.json:
+        print(json.dumps(verdict, indent=2))
+    else:
+        status = "OK" if verdict["ok"] else "FAIL"
+        print(f"regress {status}: {verdict['checked']} keys checked vs "
+              f"{os.path.basename(args.against)}")
+        for f in verdict["findings"]:
+            if f["kind"] == "starved":
+                print(f"  STARVED {f['key']}: baseline had "
+                      f"{f.get('baseline')}, candidate is null — "
+                      f"{f['reason']}")
+            else:
+                print(f"  REGRESSION {f['key']}: {f['baseline']} -> "
+                      f"{f['candidate']} (drop {f['drop_frac']:.1%} > "
+                      f"tol {f['tolerance']:.1%})")
+    return verdict["exit_code"]
+
+
+if __name__ == "__main__":
+    sys.exit(main())
