@@ -95,11 +95,26 @@ class BalanceState:
     cont: list[float] = field(default_factory=list)
     prev_delta: list[float] = field(default_factory=list)
     damp: list[float] = field(default_factory=list)
+    # one-shot warm start consumed: the SECOND measured rebalance may
+    # jump undamped to the rate-implied split (``jump_start``);
+    # afterwards the damped loop takes over (measured per-item rates are
+    # fully informative once — noise handling is the damped loop's job)
+    jumped: bool = False
+    # the jump is ARMED by the first measured rebalance but fires on the
+    # second: first-window benches routinely carry one lane's jit
+    # compile (the executable-cache miss lands on whichever lane
+    # dispatched first) and the transfer tuner's measuring fence, and an
+    # undamped jump onto a ~20x-inflated bench would near-starve that
+    # lane in one step — the damped first iteration absorbs the
+    # contamination instead
+    warm: bool = False
 
     def reset(self, ranges: list[int], damping: float) -> None:
         self.cont = [float(r) for r in ranges]
         self.prev_delta = [0.0] * len(ranges)
         self.damp = [damping] * len(ranges)
+        self.jumped = False
+        self.warm = False
 
 
 def per_iteration_benches(
@@ -145,6 +160,8 @@ def load_balance(
     damping: float = DAMPING,
     carry: list[float] | None = None,
     state: BalanceState | None = None,
+    transfer_ms: list[float] | None = None,
+    jump_start: bool = False,
 ) -> list[int]:
     """One balancer iteration; returns new per-chip ranges summing to
     ``total``, each a multiple of ``step`` (≥ 0).
@@ -159,6 +176,30 @@ def load_balance(
     damping (supersedes ``carry``; see the class docstring).  Passing
     neither, or only ``carry``, keeps the reference's fixed-damping
     behavior (HelperFunctions.cs:246) as the parity mode.
+
+    ``transfer_ms`` — optional per-chip separately-measured transfer wall
+    (H2D staging + D2H materialization) of the same window.  Each chip's
+    effective time becomes ``max(bench_i, transfer_i)``: a lane cannot
+    compute data its link has not delivered, so its measured link time is
+    a FLOOR on its cost — a lane with a slow effective link stops being
+    assigned shares its (overlapped, hence small-looking) compute bench
+    alone would justify.  This is what makes the balancer correct on rigs
+    with unequal per-device link bandwidth (the streamed-transfer path
+    overlaps transfer with compute, so the plain wall bench no longer
+    carries the transfer term by itself).
+
+    ``jump_start`` — with ``state``, the SECOND measured rebalance jumps
+    UNDAMPED to the rate-implied split (``range_i ← total · share_i``)
+    instead of creeping there at damped speed from the equal split:
+    clean benches measure per-item cost density exactly, so the damped
+    crawl only slows convergence (the r5 rig took 17 iterations; the
+    jump removes most of them).  The FIRST measured rebalance only arms
+    the jump (``BalanceState.warm``) and runs damped — first-window
+    benches routinely carry one lane's jit compile (the executable-cache
+    miss lands on whichever lane dispatched first), and an undamped jump
+    onto a compile-inflated bench would near-starve that lane in one
+    step.  One-shot per state (``BalanceState.jumped``); every later
+    iteration runs the normal damped adaptive loop.
     """
     n = len(ranges)
     if n == 1:
@@ -182,6 +223,9 @@ def load_balance(
 
     # 1-2: normalized throughput shares (measured on the quantized ranges)
     safe = [max(b, 1e-9) for b in benchmarks]
+    if transfer_ms is not None and len(transfer_ms) == n:
+        # transfer floor: effective time = max(compute bench, link time)
+        safe = [max(s, max(t, 0.0)) for s, t in zip(safe, transfer_ms)]
     tot_b = sum(safe)
 
     thr = [(tot_b / safe[i]) * (ranges[i] + 1.0) for i in range(n)]
@@ -225,7 +269,30 @@ def load_balance(
         shares = [v / s for v in shares]
 
     # 4: damped continuous update
-    if state is not None:
+    do_jump = (
+        state is not None and jump_start and not state.jumped and state.warm
+    )
+    if state is not None and jump_start and not state.jumped and not state.warm:
+        # arm only: first-window benches routinely carry one lane's jit
+        # compile and the tuner's measuring fence — jumping undamped
+        # onto a compile-inflated bench would near-starve that lane in
+        # one step, so this iteration runs damped and the NEXT measured
+        # rebalance jumps on clean benches
+        state.warm = True
+    if do_jump:
+        # transfer-aware warm start: one undamped jump to the
+        # rate-implied split (second-window benches carry per-item cost
+        # density exactly — creeping there at damped speed from the
+        # equal split is pure lost convergence)
+        state.jumped = True
+        cont = [total * v for v in shares]
+        state.prev_delta = [cont[i] - base[i] for i in range(n)]
+        state.cont = list(cont)
+        REGISTRY.counter(
+            "ck_balance_jump_total",
+            "one-shot undamped warm-start jumps to the rate-implied split",
+        ).inc()
+    elif state is not None:
         # a lagging smoother in the loop lowers the stable gain ceiling
         # (delay ~3 iters × gain must stay < 1): cap tighter when history on
         damp_max = DAMP_MAX if history is None else DAMP_MAX_SMOOTHED

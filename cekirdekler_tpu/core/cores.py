@@ -47,6 +47,7 @@ from .balance import (
     load_balance,
     per_iteration_benches,
 )
+from .stream import TransferTuner, chunk_plan
 from .worker import Worker
 
 __all__ = ["Cores", "PIPELINE_EVENT", "PIPELINE_DRIVER", "ComputePerf"]
@@ -129,7 +130,16 @@ class Cores:
         # keeps the inbound DMA lane busy when one blob's transfer
         # outlasts one compute step
         self.pipeline_lookahead = 2
-        self._enqueued: list[tuple[Worker, ClArray, int, int, bool]] = []
+        # deferred-readback records: (seq, worker, array, offset, size,
+        # write_all, compute_id) — cid rides along so the flush drain
+        # can attribute each lane's D2H wall back to the balancer
+        self._enqueued: list[tuple] = []
+        # per-cid iteration count since the last FLUSH (not the last
+        # window — _enqueue_iters resets per barrier): the drain's
+        # divisor, so the transfer feed lands in the same per-ITERATION
+        # milliseconds the enqueue benches use (a per-flush total vs a
+        # per-iteration bench would over-floor every lane ~window-size-x)
+        self._flush_iters: dict[int, int] = {}
         self._lock = threading.Lock()
         self.last_compute_id: int | None = None
         # enqueue-mode rebalance state: compute ids dispatched since the
@@ -203,6 +213,33 @@ class Cores:
         self._m_fused_iters = REGISTRY.counter(
             "ck_fused_iters_total",
             "iterations dispatched via fused ladders")
+        # ---- streamed partition transfers (the read/compute/write
+        # pipeline WITHIN one lane's partition): the plain path's
+        # monolithic upload → ladder → download becomes a chunked
+        # wavefront — the caller thread stages chunk j+1's H2D while the
+        # per-worker stream driver (depth stream_queue_depth — the
+        # double buffer) dispatches chunk j's commit + ladder launch,
+        # and retired chunks' D2H issues while later chunks compute
+        # (_run_streamed).  Chunks are step·2^k (chunk_plan), so every
+        # chunk launch is a compile-once ladder cache hit.
+        # stream_chunks: 0 = autotune (transfer_tuner), n = pin.
+        self.streamed_transfers = True
+        self.stream_chunks = 0
+        self.stream_queue_depth = 2
+        self.transfer_tuner = TransferTuner()
+        # cached handles — _run_streamed runs per phase per lane on the
+        # default-on path, no registry get-or-create there (the PR 4
+        # fused-counter discipline)
+        self._m_stream_stages = REGISTRY.counter(
+            "ck_pipeline_stages_total", "stage bodies executed",
+            engine="STREAM")
+        self._m_stream_retunes = REGISTRY.counter(
+            "ck_stream_retune_total",
+            "transfer-autotuner re-tunes forced by re-partitions")
+        # observability: per-lane chunk count of the last streamed phase
+        # (the autotuner's live choice; also exported as the
+        # ck_stream_chunk_count gauge)
+        self.last_stream_chunks: dict[int, int] = {}
         # per-cid fence splitting (VERDICT r5 #8): when on, barrier()
         # fences each compute id's last output in last-dispatch order and
         # feeds the balancer MARGINAL per-cid times instead of charging
@@ -301,9 +338,23 @@ class Cores:
                         compute_id,
                         BalanceHistory(weighted=self.adaptive_load_balancer),
                     )
+                # transfer-aware: each lane's separately-measured H2D+D2H
+                # time floors its effective cost — a lane whose link
+                # cannot feed it must not be assigned shares its compute
+                # bench alone would justify (unequal effective link
+                # bandwidth, the reference's multi-GPU PCIe reality)
+                transfer = [
+                    w.transfer_benchmarks.get(compute_id, 0.0)
+                    for w in self.workers
+                ]
+                if not any(t > 0.0 for t in transfer):
+                    transfer = None
                 if self.adaptive_load_balancer:
                     state = self._balance_states.setdefault(compute_id, BalanceState())
-                    ranges = load_balance(bench, ranges, total, step, hist, state=state)
+                    ranges = load_balance(
+                        bench, ranges, total, step, hist, state=state,
+                        transfer_ms=transfer, jump_start=True,
+                    )
                 else:
                     carry = self._cont_ranges.setdefault(compute_id, [])
                     ranges = load_balance(bench, ranges, total, step, hist, carry=carry)
@@ -455,6 +506,21 @@ class Cores:
                     "ck_balance_share", "per-chip work-item share",
                     cid=compute_id, lane=i,
                 ).set(r)
+            if old_ranges and (
+                len(old_ranges) != len(ranges)
+                or any(abs(a - b) > step
+                       for a, b in zip(ranges, old_ranges))
+            ):
+                # a MATERIAL re-partition moved the bytes: the transfer
+                # autotuner's observations describe partitions that no
+                # longer exist — drop them (the duplex-probe link seed
+                # survives) so the next streamed phase re-tunes its
+                # chunk count.  ±1-quantization-step flaps are absorbed
+                # instead: bytes_bucket's power-of-two hysteresis exists
+                # for exactly those wiggles, and wiping on every flap
+                # would park every key in a perpetual measuring run
+                self.transfer_tuner.on_repartition()
+                self._m_stream_retunes.inc()
         if self.enqueue_mode and old_ranges and ranges != old_ranges:
             # the balancer moved shares between syncs: host arrays must be
             # made current BEFORE any chip uploads its newly-acquired region
@@ -477,10 +543,15 @@ class Cores:
             self._flush_and_reset_coverage()
         # a chip whose share was quantized to zero never re-runs its bench;
         # decay its stale measurement so a one-off slow call (e.g. first-call
-        # compile) cannot starve it permanently
+        # compile) cannot starve it permanently.  The transfer floor decays
+        # with it — a zero-range lane moves no bytes either, so a transient
+        # link hiccup would otherwise pin max(bench, transfer) at the stale
+        # link cost forever no matter how far the compute bench decays
         for i, w in enumerate(self.workers):
             if ranges[i] <= 0 and w.benchmarks.get(compute_id, 0.0) > 0.0:
                 w.benchmarks[compute_id] *= 0.5
+            if ranges[i] <= 0 and w.transfer_benchmarks.get(compute_id, 0.0) > 0.0:
+                w.transfer_benchmarks[compute_id] *= 0.5
 
         # write_all owner: "device i writes array (i mod numDevices)"
         # (Worker.cs:871-885) — but only among chips that actually run,
@@ -588,6 +659,9 @@ class Cores:
         self._enqueue_cids.add(compute_id)
         self._enqueue_iters[compute_id] = (
             self._enqueue_iters.get(compute_id, 0) + 1
+        )
+        self._flush_iters[compute_id] = (
+            self._flush_iters.get(compute_id, 0) + 1
         )
 
     def _fused_signature(
@@ -873,7 +947,45 @@ class Cores:
                     write_all_owner,
                 )
                 return
-            # H2D
+            streamed, key_bytes = self._run_streamed(
+                w, kernel_names, params, compute_id, offset, size,
+                local_range, global_range, value_args, single,
+                write_all_owner,
+            )
+            if streamed:
+                return  # chunked wavefront handled the phase
+            t_phase0 = time.perf_counter()
+            # key_bytes is _run_streamed's own bytes key for this phase
+            # (one formula, computed once).  None means streaming was
+            # off or could not apply — then the tuner neither measures
+            # nor observes: the phase can never stream, and with the
+            # kill switch off the monolithic path must not pay key
+            # computation or the tuner lock at all.
+            tuner_key = (
+                self._tuner_kernel_key(kernel_names, value_args)
+                if key_bytes else None
+            )
+            # the tuner's MEASURING run (first contact for this key):
+            # pay one fence after the launches so the wall splits into
+            # honest phases — without it the async launches retire
+            # inside the D2H timing window and C lands in D, leaving
+            # the model a (U, ~0, C+D) estimate that under-chunks
+            measuring = (
+                tuner_key is not None
+                and not self.no_compute_mode
+                and not self.transfer_tuner.has_obs(
+                    w.index, tuner_key, key_bytes
+                )
+            )
+            # H2D — t_up_stream times only the CHUNK-STREAMABLE uploads
+            # (partial_read partitions, the ones _stream_key_bytes
+            # counts): whole-array uploads of non-partial operands are
+            # serial in the streamed path too (up-front, un-hideable),
+            # so their wall must land in the tuner's C, not its U — a U
+            # inflated by un-hideable bytes over-credits chunking and
+            # mis-learns every lane's per-chunk overhead
+            t_up = 0.0
+            t_up_stream = 0.0
             for idx, p in enumerate(params):
                 fl = p.flags
                 if fl.read and not fl.write_only:
@@ -883,7 +995,12 @@ class Cores:
                         p, 0 if full else offset * epw, p.size if full else size * epw
                     ):
                         continue  # data lives in HBM across enqueued computes
+                    t0u = time.perf_counter()
                     w.upload(p, offset * epw, size * epw, full)
+                    dt_u = time.perf_counter() - t0u
+                    t_up += dt_u
+                    if fl.partial_read:
+                        t_up_stream += dt_u
                 else:
                     w.ensure_resident(p)
             # compute
@@ -894,6 +1011,8 @@ class Cores:
                     repeats=self.repeat_count, sync_kernel=self.repeat_sync_kernel,
                     compute_id=compute_id,
                 )
+                if measuring:
+                    w.fence()
             t_dispatched = time.perf_counter() if self.trace_lanes else 0.0
             # D2H
             handles = []
@@ -909,7 +1028,7 @@ class Cores:
                             self._enqueue_seq += 1
                             self._enqueued.append(
                                 (self._enqueue_seq, w, p, offset, size,
-                                 fl.write_all)
+                                 fl.write_all, compute_id)
                             )
                     continue
                 epw = fl.elements_per_work_item
@@ -926,8 +1045,15 @@ class Cores:
                     handles.append(
                         w.download_async(p, offset * epw, size * epw, full)
                     )
+            t0d = time.perf_counter()
             for h in handles:
                 Worker.finish_download(h)
+            t_down = time.perf_counter() - t0d if handles else 0.0
+            self._note_transfer(
+                w, tuner_key, compute_id, key_bytes or 0, t_up, t_down,
+                time.perf_counter() - t_phase0, fenced=measuring,
+                u_tune_s=t_up_stream,
+            )
             if self.trace_lanes:
                 with self._lock:
                     self.lane_trace.setdefault(compute_id, []).append(
@@ -935,6 +1061,308 @@ class Cores:
                     )
         finally:
             w.end_bench(compute_id)
+
+    def _stream_key_bytes(
+        self, w: Worker, params: Sequence[ClArray], offset: int, size: int,
+        single: bool,
+    ) -> int:
+        """Partition-transfer byte count of one phase under the STREAM
+        classification — the ONE formula both the autotuner's ``choose``
+        key and its ``observe`` key ride (two formulas would land the
+        measuring run's observation in a different power-of-two bucket
+        than the lookup, leaving the key in a perpetual measuring run
+        and the streamed path silently dead).  Counts the phase's
+        chunk-streamable bytes: uncovered partial-read uploads plus
+        immediate ranged downloads (full-array uploads are not partition
+        transfers; enqueue-mode downloads are the flush's business).
+        Must run BEFORE the phase's uploads — they change coverage."""
+        nbytes = 0
+        for p in params:
+            fl = p.flags
+            epw = fl.elements_per_work_item
+            if fl.read and not fl.write_only and fl.partial_read:
+                # mirrors _run_streamed's up_parts test: on a single
+                # device the range IS the whole array
+                if not (self.enqueue_mode and w.upload_covers(
+                        p, 0 if single else offset * epw,
+                        p.size if single else size * epw)):
+                    nbytes += epw * size * p.host().dtype.itemsize
+            if (not self.enqueue_mode and fl.write and not fl.read_only
+                    and not fl.write_all):
+                nbytes += epw * size * p.host().dtype.itemsize
+        return nbytes
+
+    @staticmethod
+    def _tuner_kernel_key(kernel_names, value_args) -> tuple:
+        """The autotuner's per-compute kernel key: the kernel names PLUS
+        the value-arg signature — runtime values change the kernel's
+        compute time (an iteration-count value is the common case), and
+        a key that ignored them would reuse a stale C estimate across a
+        100x compute change with no re-measure.  Dict-shaped values
+        (per-kernel maps, Worker.launch) key on sorted items — tuple()
+        of a dict keeps only the NAMES and would collapse a 100x value
+        change into one key.  Unhashable values (array-valued args)
+        degrade to the names alone."""
+        try:
+            if isinstance(value_args, dict):
+                vkey = tuple(sorted(value_args.items()))
+            else:
+                vkey = tuple(value_args) if value_args else ()
+            key = (tuple(kernel_names), vkey)
+            hash(key)
+            return key
+        except TypeError:
+            return (tuple(kernel_names), None)
+
+    def _note_transfer(
+        self, w: Worker, tuner_key, compute_id: int, nbytes: int,
+        u_s: float, d_s: float, wall_s: float, chunks: int = 1,
+        fenced: bool = False, u_tune_s: float | None = None,
+    ) -> None:
+        """Record one phase's measured transfer split: the per-cid
+        transfer bench (telemetry here — in immediate paths it is a
+        subset of the same wall the compute bench carries, so the
+        balancer floor binds at the enqueue FLUSH drain, see
+        ``_finish_deferred``), and (when the phase was a streaming
+        candidate — ``tuner_key`` not None — and moved partition bytes)
+        a tuner observation: FENCED monolithic runs teach the model its
+        honest U/C/D for this (lane, kernel+values, bytes) point,
+        unfenced ones only clamp (their async launches retire inside the
+        D2H window, so the split is contaminated), chunked runs refine
+        the lane's real per-chunk overhead.  ``tuner_key`` None means
+        the phase can never stream (or the kill switch is off): the
+        tuner lock is not taken at all.  ``nbytes`` is the
+        ``_stream_key_bytes`` value of the SAME phase.  ``u_tune_s``
+        restricts the tuner's U to the CHUNK-STREAMABLE uploads when
+        the phase also moved whole-array operands (those are serial in
+        the streamed path too — their wall belongs in C); the balancer
+        floor keeps the TOTAL u_s."""
+        u_ms, d_ms = u_s * 1000.0, d_s * 1000.0
+        if not self.enqueue_mode:
+            # immediate path: one call = one iteration, so the phase
+            # wall is unit-consistent with the per-call compute bench.
+            # In ENQUEUE mode the flush drain owns this dict — its
+            # values are per-ITERATION (divided by the window's count,
+            # _finish_deferred); an in-window phase wall is per-WINDOW
+            # scaled (a post-coverage-reset phase re-uploads the whole
+            # partition once for N iterations) and steady covered
+            # phases are 0.0 — either write would corrupt the floor
+            # the next rebalance reads
+            w.transfer_benchmarks[compute_id] = u_ms + d_ms
+        tune_u_ms = u_ms if u_tune_s is None else u_tune_s * 1000.0
+        if tuner_key is not None and nbytes > 0 and (
+                tune_u_ms > 0.0 or d_ms > 0.0):
+            c_ms = max(wall_s * 1000.0 - tune_u_ms - d_ms, 0.0)
+            self.transfer_tuner.observe(
+                w.index, tuner_key, nbytes, tune_u_ms, c_ms, d_ms,
+                chunks=chunks, wall_ms=wall_s * 1000.0, fenced=fenced,
+            )
+
+    def _run_streamed(
+        self,
+        w: Worker,
+        kernel_names: Sequence[str],
+        params: Sequence[ClArray],
+        compute_id: int,
+        offset: int,
+        size: int,
+        local_range: int,
+        global_range: int,
+        value_args,
+        single: bool,
+        write_all_owner: dict[int, int],
+    ) -> tuple[bool, int | None]:
+        """STREAM engine — the chunked double-buffered partition
+        transfer path.  Returns ``(handled, key_bytes)``: ``handled``
+        False means the caller falls through to the monolithic path
+        (the identity fallback) — streaming could not apply or the
+        autotuner picked 1 chunk; ``key_bytes`` is the phase's
+        ``_stream_key_bytes`` value when it was computed (the phase IS
+        a streaming candidate — the monolithic fallback uses it for the
+        tuner's measuring run and observation) and None when streaming
+        was gated off before the key existed (then the monolithic path
+        must not pay key computation or the tuner lock at all — the
+        kill-switch contract).
+
+        The lane's timeline becomes a true read/compute/write pipeline:
+        the CALLER thread is the transfer lane — it stages chunk j's H2D
+        (the DMA starts immediately) and submits chunk j's closure
+        (commit + ladder launch + D2H issue) to the per-worker stream
+        driver, whose depth (``stream_queue_depth``, default 2) bounds
+        how far staging runs ahead of dispatch — the double buffer.
+        Chunks are ``step·2^k`` (``chunk_plan``), so every chunk launch
+        hits the compile-once ladder executables; the kernel sequence
+        stays KERNEL-MAJOR exactly like ``Worker.launch`` (kernel k
+        covers the whole range, ascending offsets, before kernel k+1),
+        so results are bit-identical to the monolithic path — the only
+        thing that moves is WHEN transfers are issued.  Uploads
+        interleave with the FIRST kernel's chunk launches, downloads
+        with the LAST kernel's (one kernel: both in one wavefront);
+        middle kernels launch whole-range.
+
+        Runs under the worker's phase lock (the caller holds it), which
+        is why the stream-driver closures never take worker locks — see
+        ``Worker.stream_dispatch_async``."""
+        if (
+            not self.streamed_transfers
+            or self.no_compute_mode
+            or self.repeat_count > 1
+            or self.repeat_sync_kernel
+            or self.trace_lanes
+        ):
+            return False, None
+        step = local_range
+        max_chunks = size // step if step > 0 else 0
+        if max_chunks < 2:
+            return False, None
+        # classify the phase's transfers exactly like the monolithic path
+        up_parts: list[ClArray] = []   # chunk-streamed partition uploads
+        up_full: list[ClArray] = []    # whole-array uploads (up-front)
+        ensure: list[ClArray] = []
+        for p in params:
+            fl = p.flags
+            if fl.read and not fl.write_only:
+                epw = fl.elements_per_work_item
+                full = single or not fl.partial_read
+                if self.enqueue_mode and w.upload_covers(
+                    p, 0 if full else offset * epw, p.size if full else size * epw
+                ):
+                    continue  # resident across enqueued computes
+                # a PARTIAL-read array chunk-streams over the lane's
+                # range even on a single device (there the range IS the
+                # whole array, so ranged chunks == the full upload);
+                # non-partial arrays must land whole before any launch
+                # (the kernel may read outside the lane's range)
+                (up_parts if fl.partial_read else up_full).append(p)
+            else:
+                ensure.append(p)
+        down_parts: list[tuple[int, ClArray]] = []
+        if not self.enqueue_mode:
+            for idx, p in enumerate(params):
+                fl = p.flags
+                if fl.write and not fl.read_only and not fl.write_all:
+                    down_parts.append((idx, p))
+        if not up_parts and not down_parts:
+            # nothing to overlap — monolithic path is exact
+            return False, None
+        nbytes = self._stream_key_bytes(w, params, offset, size, single)
+        tuner_key = self._tuner_kernel_key(kernel_names, value_args)
+        chunks = self.stream_chunks or self.transfer_tuner.choose(
+            w.index, tuner_key, nbytes, max_chunks
+        )
+        chunks = min(max(int(chunks), 1), max_chunks)
+        # record the live choice even when it is "monolithic" — an
+        # artifact saying chunks=1 ("the autotuner judged chunk overhead
+        # to outweigh overlap on this lane") beats a stale count
+        self.last_stream_chunks[w.index] = chunks
+        w.m_chunk_count.set(chunks)
+        if chunks <= 1:
+            return False, nbytes
+        plan = chunk_plan(size, step, chunks)
+        _tt = TRACER.t0()
+        t_phase0 = time.perf_counter()
+        for p in up_full:
+            w.upload(p, 0, p.size, True)
+        for p in ensure:
+            w.ensure_resident(p)
+        handles: list = []
+        stage_s = [0.0]
+        depth = max(1, int(self.stream_queue_depth))
+        names = list(kernel_names)
+        last = len(names) - 1
+        try:
+            for ki, name in enumerate(names):
+                do_up = bool(up_parts) and ki == 0
+                do_down = bool(down_parts) and ki == last
+                if not do_up and not do_down:
+                    # middle kernels: plain whole-range ladder (nothing
+                    # to overlap with — operands are already resident)
+                    w.launch(
+                        self.program, [name], params, value_args, offset,
+                        size, local_range, global_range, local_range,
+                        compute_id=compute_id,
+                    )
+                    continue
+                for coff, csz in plan:
+                    boff = offset + coff
+                    staged: list = []
+                    if do_up:
+                        t0s = time.perf_counter()
+                        staged = [
+                            w.stage_upload_chunk(
+                                p,
+                                boff * p.flags.elements_per_work_item,
+                                csz * p.flags.elements_per_work_item,
+                            )
+                            for p in up_parts
+                        ]
+                        stage_s[0] += time.perf_counter() - t0s
+
+                    def run_chunk(
+                        name=name, boff=boff, csz=csz, staged=staged,
+                        do_down=do_down,
+                    ):
+                        for s in staged:
+                            w.commit_upload(s)
+                        w.launch(
+                            self.program, [name], params, value_args,
+                            boff, csz, local_range, global_range,
+                            local_range, compute_id=compute_id,
+                        )
+                        if do_down:
+                            for _idx, p in down_parts:
+                                epw = p.flags.elements_per_work_item
+                                handles.append(
+                                    w.download_chunk_async(
+                                        p, boff * epw, csz * epw
+                                    )
+                                )
+
+                    w.stream_dispatch_async(run_chunk, depth)
+                w.drain_stream_dispatch()
+        except BaseException:
+            # closures must never outlive the phase lock the caller
+            # holds; the primary error outranks any drain follow-up
+            try:
+                w.drain_stream_dispatch()
+            except Exception:  # noqa: BLE001 - primary error wins
+                pass
+            raise
+        if self.enqueue_mode:
+            # deferred-readback records at the SAME granularity as the
+            # monolithic path (one record per array; flush() chunks the
+            # drain itself)
+            for idx, p in enumerate(params):
+                fl = p.flags
+                if fl.write and not fl.read_only:
+                    if not fl.write_all or w.index == write_all_owner.get(idx):
+                        with self._lock:
+                            self._enqueue_seq += 1
+                            self._enqueued.append(
+                                (self._enqueue_seq, w, p, offset, size,
+                                 fl.write_all, compute_id)
+                            )
+        else:
+            for idx, p in enumerate(params):
+                fl = p.flags
+                if fl.write and not fl.read_only and fl.write_all:
+                    if w.index == write_all_owner.get(idx):
+                        handles.append(w.download_async(p, 0, p.size, True))
+        t0d = time.perf_counter()
+        for h in handles:
+            Worker.finish_download(h)
+        t_down = time.perf_counter() - t0d if handles else 0.0
+        wall_s = time.perf_counter() - t_phase0
+        self._note_transfer(
+            w, tuner_key, compute_id, nbytes, stage_s[0], t_down,
+            wall_s, chunks=len(plan),
+        )
+        self._m_stream_stages.inc()
+        TRACER.record(
+            "pipeline-stage", _tt, cid=compute_id, lane=w.index,
+            tag=f"STREAM x{len(plan)}",
+        )
+        return True, nbytes
 
     def _pipeline_prologue(
         self, w: Worker, params: Sequence[ClArray], offset: int, size: int
@@ -971,6 +1399,7 @@ class Cores:
         self,
         w: Worker,
         params: Sequence[ClArray],
+        compute_id: int,
         offset: int,
         size: int,
         write_all_owner: dict[int, int],
@@ -988,7 +1417,8 @@ class Cores:
                         with self._lock:
                             self._enqueue_seq += 1
                             self._enqueued.append(
-                                (self._enqueue_seq, w, p, 0, p.size, True)
+                                (self._enqueue_seq, w, p, 0, p.size, True,
+                                 compute_id)
                             )
                     else:
                         handles.append(w.download_async(p, 0, p.size, True))
@@ -996,7 +1426,8 @@ class Cores:
                 with self._lock:
                     self._enqueue_seq += 1
                     self._enqueued.append(
-                        (self._enqueue_seq, w, p, offset, size, False)
+                        (self._enqueue_seq, w, p, offset, size, False,
+                         compute_id)
                     )
         for h in handles:
             Worker.finish_download(h)
@@ -1052,7 +1483,9 @@ class Cores:
                         continue  # deferred in the epilogue as one record
                     epw = fl.elements_per_work_item
                     handles.append(w.download_async(p, boff * epw, blob * epw, False))
-        self._pipeline_epilogue(w, params, offset, size, write_all_owner, handles)
+        self._pipeline_epilogue(
+            w, params, compute_id, offset, size, write_all_owner, handles
+        )
         REGISTRY.counter(
             "ck_pipeline_stages_total", "stage bodies executed",
             engine="DRIVER",
@@ -1141,7 +1574,9 @@ class Cores:
                 for idx, p in writers:
                     epw = p.flags.elements_per_work_item
                     handles.append(w.download_async(p, boff * epw, blob * epw, False))
-        self._pipeline_epilogue(w, params, offset, size, write_all_owner, handles)
+        self._pipeline_epilogue(
+            w, params, compute_id, offset, size, write_all_owner, handles
+        )
         REGISTRY.counter(
             "ck_pipeline_stages_total", "stage bodies executed",
             engine="EVENT",
@@ -1173,18 +1608,75 @@ class Cores:
         takes each worker's phase lock per record: another host thread's
         lane may be mid-phase replacing buffer entries) and the atomic
         rebalance flush (whose caller already holds every worker
-        lock)."""
+        lock).  Returns ``(handle, worker, cid)`` entries for
+        :meth:`_finish_deferred`."""
         handles = []
-        for _, w, p, offset, size, write_all in self._latest_records(pending):
+
+        def add(h, w, cid):
+            handles.append((h, w, cid))
+
+        for _, w, p, offset, size, write_all, cid in self._latest_records(
+            pending
+        ):
             epw = p.flags.elements_per_work_item
             with (w.lock if lock_each else nullcontext()):
                 if write_all:
-                    handles.append(w.download_async(p, 0, p.size, True))
+                    add(w.download_async(p, 0, p.size, True), w, cid)
+                    continue
+                # streamed drain: a large deferred record splits into
+                # chunks so a chunk's host memcpy (finish_download)
+                # overlaps the NEXT chunks' still-in-flight D2H instead
+                # of the whole fence draining at once.  finish order is
+                # issue order, so host writes stay chronological.
+                chunks = 1
+                if self.streamed_transfers and size > 1:
+                    nbytes = size * epw * p.host().dtype.itemsize
+                    chunks = self.stream_chunks or self.transfer_tuner.choose(
+                        w.index, "flush-d2h", nbytes, size,
+                        has_compute=False,
+                    )
+                if chunks > 1:
+                    for coff, csz in chunk_plan(size, 1, chunks):
+                        add(
+                            w.download_chunk_async(
+                                p, (offset + coff) * epw, csz * epw
+                            ),
+                            w, cid,
+                        )
                 else:
-                    handles.append(
-                        w.download_async(p, offset * epw, size * epw, False)
+                    add(
+                        w.download_async(p, offset * epw, size * epw, False),
+                        w, cid,
                     )
         return handles
+
+    def _finish_deferred(self, entries, iters: dict[int, int]) -> None:
+        """Join the flush's D2H handles in issue order, timing each
+        (lane, cid)'s share of the drain into
+        ``Worker.transfer_benchmarks`` — the integrated site where the
+        balancer's transfer floor can BIND: in steady enqueue state a
+        lane's in-window bench excludes transfers entirely (uploads
+        covered, downloads deferred to here), so a slow effective link
+        shows up only in this drain.  The drain is divided by the cid's
+        iterations since the last flush (``iters``) because the enqueue
+        benches the floor compares against are per-ITERATION
+        (balance.per_iteration_benches) — feeding the raw per-flush
+        total would over-floor every lane by the window count and snap
+        converged shares back toward equal.  Attribution is approximate
+        — the finish that waits absorbs shared-link contention — but it
+        is a measured per-lane link cost where the compute bench has
+        none."""
+        acc: dict[tuple[Worker, int], float] = {}
+        for h, w, cid in entries:
+            t0 = time.perf_counter()
+            Worker.finish_download(h)
+            acc[(w, cid)] = acc.get((w, cid), 0.0) + (
+                time.perf_counter() - t0
+            )
+        for (w, cid), s in acc.items():
+            w.transfer_benchmarks[cid] = (
+                s * 1000.0 / max(1, iters.get(cid, 1))
+            )
 
     def flush(self) -> None:
         """Read back and join everything deferred by enqueue mode.  Any
@@ -1193,8 +1685,11 @@ class Cores:
         self._fused_close()
         with self._lock:
             pending, self._enqueued = self._enqueued, []
-        for h in self._start_deferred_downloads(pending, lock_each=True):
-            Worker.finish_download(h)
+            flush_iters, self._flush_iters = self._flush_iters, {}
+        self._finish_deferred(
+            self._start_deferred_downloads(pending, lock_each=True),
+            flush_iters,
+        )
 
     def _flush_and_reset_coverage(self) -> None:
         """The sync-point-rebalance flush: read back every deferred record
@@ -1225,8 +1720,11 @@ class Cores:
                 stack.enter_context(w.lock)
             with self._lock:
                 pending, self._enqueued = self._enqueued, []
-            for h in self._start_deferred_downloads(pending, lock_each=False):
-                Worker.finish_download(h)
+                flush_iters, self._flush_iters = self._flush_iters, {}
+            self._finish_deferred(
+                self._start_deferred_downloads(pending, lock_each=False),
+                flush_iters,
+            )
             for w in self.workers:
                 w.reset_coverage()
 

@@ -168,6 +168,49 @@ class NumberCruncher:
         return self.cores.fused_stats
 
     @property
+    def streamed_transfers(self) -> bool:
+        """Streamed partition transfers (default True): the plain path's
+        monolithic upload → ladder → download becomes a chunked
+        double-buffered read/compute/write wavefront per lane — chunk
+        j+1's H2D overlaps chunk j's kernel execution, retired chunks'
+        D2H overlaps later chunks' compute.  Chunk counts are autotuned
+        per (lane, kernel, bytes) unless ``stream_chunks`` pins them;
+        results are bit-identical to the monolithic path
+        (tests/test_stream.py pins it)."""
+        return self.cores.streamed_transfers
+
+    @streamed_transfers.setter
+    def streamed_transfers(self, v: bool) -> None:
+        self.cores.streamed_transfers = bool(v)
+
+    @property
+    def stream_chunks(self) -> int:
+        """Pinned chunk count for streamed transfers (0 = autotune via
+        ``cores.transfer_tuner``, 1 = effectively monolithic)."""
+        return self.cores.stream_chunks
+
+    @stream_chunks.setter
+    def stream_chunks(self, v: int) -> None:
+        self.cores.stream_chunks = max(0, int(v))
+
+    @property
+    def stream_queue_depth(self) -> int:
+        """Stream-driver double-buffer depth: how many chunks the host
+        may stage ahead of the dispatched chunk (default 2)."""
+        return self.cores.stream_queue_depth
+
+    @stream_queue_depth.setter
+    def stream_queue_depth(self, v: int) -> None:
+        self.cores.stream_queue_depth = max(1, int(v))
+
+    @property
+    def transfer_tuner(self):
+        """The online chunk-count autotuner (core/stream.TransferTuner):
+        seed it from a duplex probe via ``seed_link`` or let streamed
+        runs teach it."""
+        return self.cores.transfer_tuner
+
+    @property
     def smooth_load_balancer(self) -> bool:
         return self.cores.smooth_load_balancer
 

@@ -191,6 +191,16 @@ class Worker:
         # per-compute-id accumulated wall ms (reference: Worker.cs:190,753-807)
         self.benchmarks: dict[int, float] = {}
         self._bench_t0: dict[int, float] = {}
+        # per-compute-id TRANSFER wall ms, measured separately from the
+        # phase wall: per-phase H2D staging + D2H materialization in the
+        # immediate paths (telemetry — a subset of the same wall the
+        # compute bench carries), and the lane's share of the enqueue
+        # FLUSH drain (Cores._finish_deferred — where the balancer's
+        # transfer floor genuinely binds: steady-state enqueue benches
+        # exclude transfers entirely).  Fed into
+        # core/balance.load_balance(transfer_ms=...) so lanes with
+        # unequal effective link bandwidth stop getting equal shares.
+        self.transfer_benchmarks: dict[int, float] = {}
         # last H2D transfer path taken ("dlpack-zero-copy" | "dlpack+move" |
         # "staged-dma") — observability for the zero_copy flag
         self.last_upload_path: str | None = None
@@ -217,6 +227,12 @@ class Worker:
         # depth-limited per-device dispatch driver (fused path); lazy —
         # workers outside the fused path never start the thread
         self._driver: _DriverQueue | None = None
+        # SECOND driver for the streamed-transfer path (Cores._run_streamed):
+        # its closures run while the submitting thread HOLDS this worker's
+        # phase lock, so they must never take worker locks — sharing the
+        # fused driver would let a fused closure (which does take w.lock)
+        # queue ahead of a streamed closure and deadlock the drain
+        self._stream_driver: _DriverQueue | None = None
         # always-on health metrics (metrics/registry.py): transfer bytes,
         # fence waits, driver occupancy — handles cached here because the
         # lane label is static for the worker's lifetime
@@ -231,6 +247,21 @@ class Worker:
             "ck_fence_seconds", "fence wait duration", lane=index)
         self._m_driver_depth = REGISTRY.gauge(
             "ck_driver_queue_depth", "fused-dispatch driver FIFO occupancy",
+            lane=index)
+        # streamed-transfer health: chunks moved each direction, the
+        # stream driver's backlog, and the autotuner's current choice
+        # (Cores sets the gauge when it plans a streamed phase)
+        self._m_h2d_chunks = REGISTRY.counter(
+            "ck_stream_chunks_total", "streamed transfer chunks",
+            dir="h2d", lane=index)
+        self._m_d2h_chunks = REGISTRY.counter(
+            "ck_stream_chunks_total", "streamed transfer chunks",
+            dir="d2h", lane=index)
+        self._m_stream_depth = REGISTRY.gauge(
+            "ck_stream_queue_depth", "streamed-transfer driver FIFO occupancy",
+            lane=index)
+        self.m_chunk_count = REGISTRY.gauge(
+            "ck_stream_chunk_count", "autotuner-chosen chunk count",
             lane=index)
 
     # -- benchmarks ----------------------------------------------------------
@@ -332,12 +363,15 @@ class Worker:
             self.markers.reach_when_ready(out)
         TRACER.record("upload", _tt, lane=self.index, tag=arr.name)
 
-    def stage_upload(self, arr: ClArray, offset_elems: int, size_elems: int):
+    def stage_upload(self, arr: ClArray, offset_elems: int, size_elems: int,
+                     kind: str = "upload"):
         """Start the H2D DMA for a range slice WITHOUT inserting it into the
         chip's buffer yet — the event-pipeline engine stages blob j+1's
         transfer while blob j computes (reference: the read queue of the
         3-queue event pipeline, Cores.cs:1263-1295).  Returns a handle for
-        :meth:`commit_upload`."""
+        :meth:`commit_upload`.  ``kind`` names the span recorded
+        (``upload-chunk`` for one ladder-aligned chunk of a streamed
+        partition upload — same split as :meth:`download_async`)."""
         _tt = TRACER.t0()
         host = arr.host()
         if self.markers is not None:
@@ -345,8 +379,19 @@ class Worker:
         sl = self._h2d(host[offset_elems : offset_elems + size_elems], arr.flags.zero_copy)
         if self.markers is not None:
             self.markers.reach_when_ready(sl)
-        TRACER.record("upload", _tt, lane=self.index, tag=f"stage:{arr.name}")
+        tag = (f"{arr.name}@{offset_elems}+{size_elems}"
+               if kind == "upload-chunk" else f"stage:{arr.name}")
+        TRACER.record(kind, _tt, lane=self.index, tag=tag)
         return (arr, sl, offset_elems)
+
+    def stage_upload_chunk(self, arr: ClArray, offset_elems: int, size_elems: int):
+        """One ladder-aligned chunk of a STREAMED partition upload: the
+        caller thread is the transfer lane — it stages chunk j+1 while
+        the stream driver dispatches chunk j's commit+launch."""
+        self._m_h2d_chunks.inc()
+        return self.stage_upload(
+            arr, offset_elems, size_elems, kind="upload-chunk"
+        )
 
     def commit_upload(self, staged) -> None:
         """Insert a staged slice into the range buffer (the device-side
@@ -400,6 +445,26 @@ class Worker:
         started."""
         if self._driver is not None:
             self._driver.drain()
+
+    # -- stream driver (streamed-transfer path) ------------------------------
+    def stream_dispatch_async(self, fn: Callable[[], None], depth: int = 2) -> None:
+        """Queue a streamed-transfer closure (commit + launch + D2H
+        issue) on this chip's STREAM driver thread — separate from the
+        fused driver on purpose: these closures run while the submitter
+        holds the worker's phase lock, so they must never contend for
+        worker locks (a fused closure queued ahead would deadlock the
+        drain).  ``depth`` bounds how many chunks the caller thread may
+        stage ahead of the dispatched chunk — the double buffer."""
+        if self._stream_driver is None:
+            self._stream_driver = _DriverQueue(self._m_stream_depth)
+        self._stream_driver.submit(fn, depth)
+
+    def drain_stream_dispatch(self) -> None:
+        """Wait until every streamed-transfer closure has run (host-side
+        dispatch; device completion is the fence's business), re-raising
+        the first failure."""
+        if self._stream_driver is not None:
+            self._stream_driver.drain()
 
     # -- launch --------------------------------------------------------------
     def launch(
@@ -555,9 +620,14 @@ class Worker:
                 self.markers.reach_when_ready(bufs[0])
 
     # -- readback ------------------------------------------------------------
-    def download_async(self, arr: ClArray, offset_elems: int, size_elems: int, full: bool):
+    def download_async(
+        self, arr: ClArray, offset_elems: int, size_elems: int, full: bool,
+        kind: str = "download",
+    ):
         """D2H: start an async copy of this chip's range (or the full array);
-        returns a handle consumed by :meth:`finish_download`."""
+        returns a handle consumed by :meth:`finish_download`.  ``kind``
+        names the span the finish records (``download-chunk`` for one
+        ladder-aligned chunk of a streamed partition download)."""
         buf = self._buffers[id(arr)]
         if full:
             out = buf
@@ -572,11 +642,20 @@ class Worker:
         except Exception:
             pass
         return (arr, out, off, self.markers, self.index,
-                self._m_download_bytes)
+                self._m_download_bytes, kind)
+
+    def download_chunk_async(self, arr: ClArray, offset_elems: int, size_elems: int):
+        """One ladder-aligned chunk of a STREAMED partition download:
+        issued as soon as the chunk's last kernel launch is dispatched,
+        so retired chunks drain D2H while later chunks still compute."""
+        self._m_d2h_chunks.inc()
+        return self.download_async(
+            arr, offset_elems, size_elems, False, kind="download-chunk"
+        )
 
     @staticmethod
     def finish_download(handle) -> None:
-        arr, out, off, markers, lane, byte_counter = handle
+        arr, out, off, markers, lane, byte_counter, kind = handle
         _tt = TRACER.t0()
         host = arr.host()
         data = np.asarray(out)
@@ -601,7 +680,7 @@ class Worker:
         else:
             view[:] = data
         byte_counter.inc(data.nbytes)
-        TRACER.record("download", _tt, lane=lane, tag=arr.name)
+        TRACER.record(kind, _tt, lane=lane, tag=arr.name)
         if markers is not None:
             markers.reach()
 
@@ -651,10 +730,14 @@ class Worker:
         if self._driver is not None:
             self._driver.close()
             self._driver = None
+        if self._stream_driver is not None:
+            self._stream_driver.close()
+            self._stream_driver = None
         self._buffers.clear()
         self._buffer_owner.clear()
         self._uploaded.clear()
         self.benchmarks.clear()
+        self.transfer_benchmarks.clear()
         self._cid_last_out.clear()
         if self.markers is not None:
             self.markers.close()

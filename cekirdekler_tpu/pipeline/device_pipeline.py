@@ -398,6 +398,40 @@ class ClPipeline:
             for o_slot, i_slot in zip(st.outputs[:n], nxt.inputs[:n]):
                 i_slot.value = handoff(o_slot.value, nxt)
 
+    @property
+    def streamed_transfers(self) -> bool:
+        """Streamed partition transfers inside multi-chip stages: each
+        such stage runs its kernels through a stage-local ``Cores``,
+        which chunk-streams its per-lane H2D/D2H exactly like the main
+        scheduler (core/cores._run_streamed) — stage feeds stop paying
+        the monolithic upload-before-first-launch fence.  True iff every
+        multi-chip stage has it on (single-chip stages keep values
+        device-resident and have no partition transfers to stream)."""
+        cores = [st._cores for st in self.stages if st._cores is not None]
+        return bool(cores) and all(c.streamed_transfers for c in cores)
+
+    @streamed_transfers.setter
+    def streamed_transfers(self, v: bool) -> None:
+        for st in self.stages:
+            if st._cores is not None:
+                st._cores.streamed_transfers = bool(v)
+
+    @property
+    def stream_chunks(self) -> int:
+        """Pinned chunk count for the stage-local schedulers (0 =
+        autotune; the per-stage ``Cores.transfer_tuner`` learns each
+        stage's own (lane, kernel, bytes) points independently)."""
+        for st in self.stages:
+            if st._cores is not None:
+                return st._cores.stream_chunks
+        return 0
+
+    @stream_chunks.setter
+    def stream_chunks(self, v: int) -> None:
+        for st in self.stages:
+            if st._cores is not None:
+                st._cores.stream_chunks = max(0, int(v))
+
     def performance_report(self) -> str:
         lines = ["pipeline stages:"]
         for i, st in enumerate(self.stages):

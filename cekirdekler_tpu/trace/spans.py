@@ -37,6 +37,10 @@ Span kinds used by the built-in instrumentation (callers may add more):
 ``enqueue`` (a compute() dispatch), ``split`` (first range table),
 ``rebalance`` (the balancer moved shares), ``launch`` (kernel dispatch),
 ``fence`` (retirement wait), ``upload`` (H2D), ``download`` (D2H),
+``upload-chunk`` / ``download-chunk`` (one ladder-aligned chunk of a
+STREAMED partition transfer — the chunked double-buffered H2D/D2H path,
+``Cores._run_streamed``; the monolithic kinds above stay for whole-range
+transfers so the two paths are distinguishable in every report),
 ``pipeline-stage`` (one pipeline engine/stage body), ``pool-task``
 (device-pool task), ``dcn-exchange`` (cross-host collective), ``fused``
 (fused-iteration window flush — spans tag ``xK`` for a K-iteration
@@ -57,7 +61,8 @@ __all__ = ["Span", "Tracer", "TRACER", "SPAN_KINDS", "tracing"]
 
 SPAN_KINDS = (
     "enqueue", "split", "rebalance", "launch", "fence",
-    "upload", "download", "pipeline-stage", "pool-task", "dcn-exchange",
+    "upload", "download", "upload-chunk", "download-chunk",
+    "pipeline-stage", "pool-task", "dcn-exchange",
     "fused",
 )
 

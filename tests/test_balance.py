@@ -273,3 +273,99 @@ def test_wrap_override_failure_leaves_flags_intact():
     with pytest.raises(ComputeValidationError):
         wrap(b, alignment_bytes=4)  # smaller than float64 itemsize
     assert b.flags == before
+
+
+# ---------------------------------------------------------------------------
+# transfer-aware balancing (ISSUE 5): link-time floor + warm-start jump
+# ---------------------------------------------------------------------------
+
+def test_transfer_floor_caps_slow_link_lane():
+    """A lane whose separately-measured transfer time dwarfs its
+    (overlapped, small-looking) compute bench must lose share: effective
+    time is max(compute, transfer) — the link is a floor."""
+    ranges = [512, 512]
+    carry = []
+    for _ in range(30):
+        # identical compute speed; lane 1's link is 3x the compute time
+        bench = [ranges[0] * 1.0, ranges[1] * 1.0]
+        transfer = [0.0, ranges[1] * 3.0]
+        ranges = load_balance(bench, ranges, 1024, 64, carry=carry,
+                              transfer_ms=transfer)
+    assert sum(ranges) == 1024
+    # converged ~3:1 (lane 1 is effectively 3x slower end-to-end)
+    assert abs(ranges[0] - 768) <= 64, ranges
+
+
+def test_transfer_floor_noop_when_transfers_overlap_fully():
+    """Transfer times below the compute bench change nothing — the floor
+    only binds when the link is the bottleneck."""
+    bench = [100.0, 100.0]
+    with_t = load_balance(bench, [512, 512], 1024, 64,
+                          transfer_ms=[10.0, 10.0])
+    without = load_balance(bench, [512, 512], 1024, 64)
+    assert with_t == without
+
+
+def test_jump_start_converges_on_second_measured_iteration():
+    """The transfer-aware warm start: the FIRST measured rebalance only
+    ARMS the jump and runs damped (first-window benches routinely carry
+    one lane's jit compile); the SECOND jumps straight to the
+    rate-implied split (the r5 rig crept there over 17 damped
+    iterations)."""
+    state = BalanceState()
+    ranges = [512, 512]
+    # lane 0 twice as fast (bench = items x per-item cost)
+    bench = [ranges[0] * 1.0, ranges[1] * 2.0]
+    ranges = load_balance(bench, ranges, 1024, 64, state=state,
+                          jump_start=True)
+    assert state.warm is True and state.jumped is False  # armed, damped
+    bench = [ranges[0] * 1.0, ranges[1] * 2.0]
+    ranges = load_balance(bench, ranges, 1024, 64, state=state,
+                          jump_start=True)
+    assert state.jumped is True
+    assert abs(ranges[0] - 683) <= 64, ranges  # 2/3 split on the jump
+    # one-shot: later iterations run the damped loop (no oscillating
+    # re-jumps on noise) and HOLD the converged split
+    for _ in range(5):
+        bench = [ranges[0] * 1.0, ranges[1] * 2.0]
+        prev = ranges
+        ranges = load_balance(bench, ranges, 1024, 64, state=state,
+                              jump_start=True)
+        assert abs(ranges[0] - prev[0]) <= 64
+    assert abs(ranges[0] - 683) <= 64, ranges
+
+
+def test_jump_start_survives_compile_contaminated_first_bench():
+    """The reason the jump fires on the SECOND measured rebalance: the
+    first window's bench routinely carries one lane's jit compile (the
+    executable-cache miss lands on whichever lane dispatched first).  An
+    undamped jump onto a 20x-inflated bench would hand that lane ~1/20
+    of its fair share in one step; the damped first iteration bounds the
+    damage, and the jump then fires on clean benches."""
+    state = BalanceState()
+    ranges = [512, 512]
+    # lane 0 paid compile: equal true rates, bench inflated 20x
+    bench = [ranges[0] * 20.0, ranges[1] * 1.0]
+    ranges = load_balance(bench, ranges, 1024, 64, state=state,
+                          jump_start=True)
+    assert ranges[0] >= 256, ranges  # damped — not starved in one step
+    # clean second window: the jump lands on the honest (equal) split
+    bench = [ranges[0] * 1.0, ranges[1] * 1.0]
+    ranges = load_balance(bench, ranges, 1024, 64, state=state,
+                          jump_start=True)
+    assert state.jumped is True
+    assert abs(ranges[0] - 512) <= 128, ranges
+
+
+def test_jump_start_resets_with_state():
+    """BalanceState.reset re-arms the jump (a device-count change makes
+    the old split meaningless — the next measured rebalances may arm and
+    jump again)."""
+    state = BalanceState()
+    for _ in range(2):
+        load_balance([1.0, 2.0], [512, 512], 1024, 64, state=state,
+                     jump_start=True)
+    assert state.jumped is True
+    state.reset([256, 256, 256, 256], 0.5)
+    assert state.jumped is False
+    assert state.warm is False

@@ -77,3 +77,86 @@ def test_small_budget_override_still_runs_best_effort_sections():
     assert s.run("baseline", lambda: "ran") == "ran"
     clock.t = 500.0  # past the capped 60% window -> best-effort skips
     assert s.run("overlap", lambda: "ran", default=None) is None
+
+
+# ---------------------------------------------------------------------------
+# fairness rotation (ISSUE 5 satellite): no section starves > 2 rounds
+# ---------------------------------------------------------------------------
+
+def test_rotation_promotes_two_round_starved_section():
+    clock = _Clock()
+    s = bench.SectionScheduler(
+        100.0, {}, clock=clock,
+        starvation_history=[{"marker_overhead"}, {"marker_overhead"}])
+    assert s.rotation["promoted"] == ["marker_overhead"]
+    assert s.rotation["starved_streak"] == ["marker_overhead"]
+    assert s.reserved["marker_overhead"] == bench.FAIRNESS_SLICE_SEC
+    # the promotion is a REAL must-run slice: it runs past budget
+    clock.t = 500.0
+    assert s.run("marker_overhead", lambda: "ran") == "ran"
+    assert "marker_overhead" not in s.errors
+
+
+def test_rotation_needs_two_consecutive_rounds():
+    for hist in ([], [{"a"}], [{"a"}, {"b"}], [{"a"}, set(), {"a"}]):
+        s = bench.SectionScheduler(100.0, {}, starvation_history=hist)
+        assert s.rotation["promoted"] is None, hist
+        assert s.rotation["starved_streak"] == []
+
+
+def test_rotation_promotes_whole_multi_member_streak():
+    """EVERY member of a multi-member streak is promoted the same round
+    — a one-per-round rotation would leave a k-member streak's last
+    member starving k+1 consecutive rounds, breaking the 'no section
+    starves more than 2 consecutive rounds' guarantee for the
+    motivating case itself (marker_overhead AND dtype_matrix starved
+    together).  The rotation anchor only orders the list."""
+    h2 = [{"a", "b"}, {"a", "b"}]
+    s2 = bench.SectionScheduler(100.0, {}, starvation_history=h2)
+    s3 = bench.SectionScheduler(100.0, {}, starvation_history=h2 + [{"a", "b"}])
+    assert set(s2.rotation["promoted"]) == {"a", "b"}
+    assert set(s3.rotation["promoted"]) == {"a", "b"}
+    assert s2.reserved["a"] == s2.reserved["b"] == bench.FAIRNESS_SLICE_SEC
+    # the anchor rotates with round count; same trajectory, same order
+    assert s2.rotation["promoted"] != s3.rotation["promoted"]
+    again = bench.SectionScheduler(100.0, {}, starvation_history=h2)
+    assert again.rotation["promoted"] == s2.rotation["promoted"]
+
+
+def test_rotation_never_shrinks_an_explicit_reservation():
+    s = bench.SectionScheduler(
+        1000.0, {"dtype_matrix": 430.0}, 
+        starvation_history=[{"dtype_matrix"}, {"dtype_matrix"}])
+    assert s.reserved["dtype_matrix"] == 430.0
+
+
+def test_rotation_decision_lands_in_artifact():
+    s = bench.SectionScheduler(
+        100.0, {}, starvation_history=[{"ov"}, {"ov"}])
+    result = {"headline": {}}
+    bench.finalize_result(result, s)
+    rot = result["scheduler_rotation"]
+    assert rot["promoted"] == ["ov"]
+    assert rot["slice_s"] == bench.FAIRNESS_SLICE_SEC
+    assert rot["rounds_seen"] == 2
+
+
+def test_starvation_history_reads_budget_skips_only(tmp_path):
+    """History counts BUDGET starvation, not crashes: a must-run slice
+    cannot fix a RuntimeError, so error nulls stay out of the streak."""
+    import json
+
+    for r in (1, 2):
+        (tmp_path / f"BENCH_r0{r}.json").write_text(json.dumps({
+            "null_sections": {
+                "ov": {"null_reason": "skipped: 1500s bench budget spent",
+                        "budget_spent_s": 1430.0},
+                "boom": {"null_reason": "RuntimeError: tunnel died",
+                          "budget_spent_s": 100.0},
+            },
+            "headline": {"mandelbrot_mpix": 1.0},
+        }))
+    hist = bench.starvation_history(str(tmp_path))
+    assert hist == [{"ov"}, {"ov"}]
+    s = bench.SectionScheduler(100.0, {}, starvation_history=hist)
+    assert s.rotation["promoted"] == ["ov"]

@@ -32,6 +32,7 @@ HEADLINE = {
     "flash_T8192_speedup_highest": 1.2,
     "nbody_e2e_enqueue_gpairs": 15.0,
     "dispatch_floor_collapse": 5.0,
+    "overlap_balanced_raw": 0.80,
 }
 
 
