@@ -668,6 +668,19 @@ def finalize_result(result: dict, sched: "SectionScheduler") -> dict:
         metrics_snap = REGISTRY.snapshot()
     except Exception as e:  # noqa: BLE001 - resilience boundary
         metrics_snap = {"error": f"{type(e).__name__}: {e}"[:200]}
+    # lane-health block (obs/health.py): the per-lane verdicts recovered
+    # from the process-wide ck_lane_health gauges — survives the
+    # per-section crunchers' disposal, so the artifact says whether any
+    # lane degraded during the WHOLE bench run, not just the last section
+    try:
+        from cekirdekler_tpu.obs.health import registry_health_summary
+
+        result["health"] = registry_health_summary(
+            metrics_snap if isinstance(metrics_snap, dict)
+            and "gauges" in metrics_snap else None
+        )
+    except Exception as e:  # noqa: BLE001 - resilience boundary
+        result["health"] = {"error": f"{type(e).__name__}: {e}"[:200]}
     try:
         here = os.path.dirname(os.path.abspath(__file__))
         regression = _load_regress().bench_epilogue(result, repo_root=here)

@@ -20,6 +20,7 @@ from .errors import (
 )
 from .hardware import AcceleratorType, Device, Devices, Platform, Platforms, all_devices, platforms
 from . import metrics  # always-on health registry (docs/OBSERVABILITY.md)
+from . import obs  # live introspection plane (docs/OBSERVABILITY.md)
 from . import trace  # span-based attribution (docs/OBSERVABILITY.md)
 
 __version__ = "0.1.0"

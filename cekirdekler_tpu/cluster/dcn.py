@@ -369,6 +369,25 @@ class DistributedAccelerator(IComputeNode):
             tag=f"dcn p{self.pid}/{self.nproc} share{my_share}",
         )
 
+    # -- introspection (obs/) ------------------------------------------------
+    def health_report(self) -> dict:
+        """This process's lane-health verdicts (``Cores.health_report``
+        of the local cruncher; ``{}`` before ``setup_nodes``).
+        ``trace.gather_cluster(acc)`` ships this automatically, so the
+        DCN tier sees every process's lane verdicts merged on one table
+        (``obs.health.cluster_health_table``)."""
+        if self.cruncher is None:
+            return {}
+        return self.cruncher.cores.health_report()
+
+    def serve_debug(self, port: int = 0, host: str = "127.0.0.1"):
+        """Start this process's live debug endpoints over the local
+        cruncher's scheduler (obs/debugserver.py) — one plane per DCN
+        process; the cluster-wide view is the aggregated snapshot."""
+        if self.cruncher is None:
+            raise CekirdeklerError("setup_nodes() must run before serve_debug()")
+        return self.cruncher.cores.serve_debug(port=port, host=host)
+
     def compute_timing(self, compute_id: int) -> list[float]:
         return list(self.timings.get(compute_id, []))
 

@@ -25,6 +25,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 
 from ..metrics.registry import REGISTRY
+from ..obs.flight import FLIGHT
 
 __all__ = [
     "load_balance",
@@ -260,6 +261,7 @@ def load_balance(
                     "ck_balance_freeze_total",
                     "quantization-floor freezes (split held, churn avoided)",
                 ).inc()
+                FLIGHT.event("balance-freeze", ranges=list(ranges))
                 return list(ranges)
 
     # 3: optional smoothing
@@ -292,6 +294,10 @@ def load_balance(
             "ck_balance_jump_total",
             "one-shot undamped warm-start jumps to the rate-implied split",
         ).inc()
+        FLIGHT.event(
+            "balance-jump",
+            target=[round(total * v, 1) for v in shares],
+        )
     elif state is not None:
         # a lagging smoother in the loop lowers the stable gain ceiling
         # (delay ~3 iters × gain must stay < 1): cap tighter when history on

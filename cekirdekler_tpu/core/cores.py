@@ -25,6 +25,7 @@ repeated computes until :meth:`flush` is called.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from collections import deque
@@ -38,6 +39,9 @@ from ..errors import ComputeValidationError
 from ..hardware import Devices
 from ..kernel.registry import KernelProgram
 from ..metrics.registry import REGISTRY
+from ..obs.debugserver import DEBUG_PORT_ENV
+from ..obs.flight import FLIGHT, record_crash
+from ..obs.health import HealthMonitor
 from ..trace.attribution import split_fence_benches
 from ..trace.spans import TRACER
 from .balance import (
@@ -264,6 +268,32 @@ class Cores:
         # join completes is the "N chips in flight concurrently" evidence.
         self.trace_lanes = False
         self.lane_trace: dict[int, list[tuple[int, float, float]]] = {}
+        # lane health scoring (obs/health.py): rolling per-lane baselines
+        # over fence walls, transfer walls, and stream-driver stalls,
+        # fed at sync points / phase tails (never the deferral hot path);
+        # health_report() / /healthz read the verdicts, suggest_drain()
+        # is advisory only (eviction is ROADMAP item 4's business)
+        self.health = HealthMonitor()
+        # live introspection plane (obs/debugserver.py): started by
+        # serve_debug() or, for the FIRST Cores in the process, by
+        # CK_DEBUG_PORT (a busy port is skipped silently — one debug
+        # plane per process, whoever binds first owns it)
+        self._debug_server = None
+        env_port = os.environ.get(DEBUG_PORT_ENV)
+        if env_port:
+            try:
+                port = int(env_port)
+                # a FIXED port only: port 0 binds a fresh ephemeral
+                # server per Cores (bind never fails), so the busy-port
+                # guard that enforces one-plane-per-process never fires
+                # and scrapers have no stable address — use
+                # serve_debug(0) explicitly for ephemeral ports
+                if port <= 0:
+                    raise ValueError("CK_DEBUG_PORT must be a fixed port > 0")
+                self.serve_debug(port)
+            except (OSError, ValueError) as e:
+                FLIGHT.event("debug-port-skipped", port=env_port,
+                             reason=f"{type(e).__name__}: {e}")
 
     @property
     def adaptive_load_balancer(self) -> bool:
@@ -487,6 +517,10 @@ class Cores:
                 "split" if not old_ranges else "rebalance",
                 cid=compute_id, tag=str(ranges),
             )
+            FLIGHT.event(
+                "rebalance", cid=compute_id, ranges=list(ranges),
+                old=list(old_ranges),
+            )
             # balancer health (metrics registry): per-cid per-device share
             # gauges set on CHANGE only (steady state costs nothing), the
             # re-split count, and how many work items the move shifted
@@ -597,6 +631,10 @@ class Cores:
             except Exception as e:  # surface the first worker error
                 errs.append(e)
         if errs:
+            # black box before the raise: a crashed compute leaves the
+            # flight ring + span ring + metrics on disk when
+            # CK_POSTMORTEM_DIR is armed (obs/flight.py)
+            record_crash("cores.compute", errs[0], lanes=self._lane_config())
             raise errs[0]
 
         TRACER.record(
@@ -759,6 +797,7 @@ class Cores:
         with self._lock:
             self._fused_sig = sig
             self._fused_run = run
+        FLIGHT.event("fused-engage", cid=compute_id, rows=len(rows))
 
     def _fused_defer(self, t_start: float, kernel_names) -> bool:
         """Count this call into the active fused window.  Returns False
@@ -821,6 +860,7 @@ class Cores:
             self.fused_stats["fused_iters"] += iters
         self._m_fused_windows.inc()
         self._m_fused_iters.inc(iters)
+        FLIGHT.event("fused-window", cid=run.compute_id, iters=iters)
         TRACER.record("fused", _tt, cid=run.compute_id, tag=f"x{iters}")
 
     def _fused_flush(self) -> None:
@@ -862,6 +902,7 @@ class Cores:
             "fused-window refusals/breaks by named reason",
             reason=reason,
         ).inc()
+        FLIGHT.event("fused-disengage", reason=reason, cid=cid)
         TRACER.instant("fused", cid=cid, tag=f"disengage:{reason}")
 
     def _fused_break(self, reason: str) -> None:
@@ -881,6 +922,12 @@ class Cores:
             except Exception as e:  # noqa: BLE001 - surfaced below
                 errs.append(e)
         if errs:
+            # a driver-queue failure surfaces HERE (the window's sync
+            # point) — the postmortem's canonical trigger: the dump
+            # carries the engage/disengage events and the driver-error
+            # span that preceded this raise
+            record_crash(
+                "cores.fused_drain", errs[0], lanes=self._lane_config())
             raise errs[0]
 
     # -- per-worker phase (reference: Cores.cs:746-835 / 1197-1980) ----------
@@ -1138,6 +1185,17 @@ class Cores:
         the streamed path too — their wall belongs in C); the balancer
         floor keeps the TOTAL u_s."""
         u_ms, d_ms = u_s * 1000.0, d_s * 1000.0
+        if u_s + d_s > 0.0 and not self.enqueue_mode:
+            # lane health: only phases that MOVED bytes feed the rolling
+            # transfer baseline — and only on the IMMEDIATE path, where
+            # one call = one iteration so the phase wall is already on
+            # the signal's per-iteration scale.  In enqueue mode the
+            # flush drain owns this signal (same ownership rule as the
+            # transfer_benchmarks dict below): an in-window phase is
+            # per-WINDOW scaled (a post-coverage-reset re-upload serves
+            # N iterations at once) and would corrupt the baseline the
+            # drain's normalized samples establish
+            self.health.observe(w.index, "transfer", u_s + d_s)
         if not self.enqueue_mode:
             # immediate path: one call = one iteration, so the phase
             # wall is unit-consistent with the per-call compute bench.
@@ -1254,6 +1312,11 @@ class Cores:
         # record the live choice even when it is "monolithic" — an
         # artifact saying chunks=1 ("the autotuner judged chunk overhead
         # to outweigh overlap on this lane") beats a stale count
+        if self.last_stream_chunks.get(w.index) != chunks:
+            # flight-record the DECISION, not the steady state: only a
+            # changed chunk count is an autotuner move worth a ring slot
+            FLIGHT.event("stream-choice", lane=w.index, chunks=chunks,
+                         nbytes=nbytes)
         self.last_stream_chunks[w.index] = chunks
         w.m_chunk_count.set(chunks)
         if chunks <= 1:
@@ -1267,6 +1330,8 @@ class Cores:
             w.ensure_resident(p)
         handles: list = []
         stage_s = [0.0]
+        stall_s = [0.0]   # backpressure waits in stream_dispatch_async
+        n_submits = [0]   # the stall normalizer: actual submits made
         depth = max(1, int(self.stream_queue_depth))
         names = list(kernel_names)
         last = len(names) - 1
@@ -1318,7 +1383,10 @@ class Cores:
                                     )
                                 )
 
+                    t0q = time.perf_counter()
                     w.stream_dispatch_async(run_chunk, depth)
+                    stall_s[0] += time.perf_counter() - t0q
+                    n_submits[0] += 1
                 w.drain_stream_dispatch()
         except BaseException:
             # closures must never outlive the phase lock the caller
@@ -1357,6 +1425,18 @@ class Cores:
             w, tuner_key, compute_id, nbytes, stage_s[0], t_down,
             wall_s, chunks=len(plan),
         )
+        # stream-driver backpressure: time the caller thread spent
+        # BLOCKED in submit because the double buffer was full — the
+        # lane-health signal for "this lane's dispatch cannot keep up
+        # with staging" (a degrading lane stalls its feeder first).
+        # PER SUBMIT, the same normalization rule as the fence/transfer
+        # signals: a retune from 4 to 16 chunks — or a 1-kernel ladder
+        # becoming a 2-kernel one (up-loop + down-loop submit the chunk
+        # plan twice) — scales the raw per-phase sum with identical
+        # per-submit health, and the un-normalized feed would read as
+        # lane degradation
+        self.health.observe(
+            w.index, "stream_stall", stall_s[0] / max(1, n_submits[0]))
         self._m_stream_stages.inc()
         TRACER.record(
             "pipeline-stage", _tt, cid=compute_id, lane=w.index,
@@ -1674,9 +1754,13 @@ class Cores:
                 time.perf_counter() - t0
             )
         for (w, cid), s in acc.items():
-            w.transfer_benchmarks[cid] = (
-                s * 1000.0 / max(1, iters.get(cid, 1))
-            )
+            per_iter_s = s / max(1, iters.get(cid, 1))
+            w.transfer_benchmarks[cid] = per_iter_s * 1000.0
+            # lane health rides the same per-iteration normalization the
+            # balancer floor uses, so windows of different sizes feed
+            # one scale (a 4x-bigger window is not a 4x-slower link)
+            if per_iter_s > 0.0:
+                self.health.observe(w.index, "transfer", per_iter_s)
 
     def flush(self) -> None:
         """Read back and join everything deferred by enqueue mode.  Any
@@ -1727,6 +1811,42 @@ class Cores:
             )
             for w in self.workers:
                 w.reset_coverage()
+
+    # -- introspection plane (obs/) ------------------------------------------
+    def serve_debug(self, port: int = 0, host: str = "127.0.0.1"):
+        """Start the live debug HTTP server (obs/debugserver.py) over
+        this scheduler: ``/metrics``, ``/statusz``, ``/tracez``,
+        ``/healthz``, ``/flightz`` on a daemon thread.  ``port=0``
+        binds an ephemeral port — read it from the returned server's
+        ``.port``.  Idempotent per Cores: a second call returns the
+        already-running server."""
+        if self._debug_server is None:
+            from ..obs.debugserver import DebugServer
+
+            self._debug_server = DebugServer(self, port=port, host=host)
+            FLIGHT.event("debug-server", port=self._debug_server.port)
+        return self._debug_server
+
+    def health_report(self) -> dict:
+        """Per-lane health verdicts (``obs/health.py``): ``{lane:
+        {"verdict": ok|suspect|degraded, "score", "evidence"}}``.
+        Advisory — ``health.suggest_drain()`` names degraded lanes,
+        nothing here acts on them."""
+        return self.health.report()
+
+    def _lane_config(self) -> dict:
+        """The postmortem's lane block: enough static configuration to
+        read a dump without the process that wrote it."""
+        return {
+            "devices": self.device_names(),
+            "ranges": {
+                str(cid): list(r) for cid, r in self.global_ranges.items()
+            },
+            "enqueue_mode": self.enqueue_mode,
+            "fused_dispatch": self.fused_dispatch,
+            "streamed_transfers": self.streamed_transfers,
+            "stream_chunks": dict(self.last_stream_chunks),
+        }
 
     # -- reporting -----------------------------------------------------------
     def performance_report(self, compute_id: int | None = None) -> str:
@@ -1830,8 +1950,27 @@ class Cores:
                 except Exception as e:
                     errs.append(e)
             if errs:
+                record_crash(
+                    "cores.barrier", errs[0], lanes=self._lane_config())
                 raise errs[0]
             if measure:
+                # lane health: each chip's fence-retire wall for this
+                # window — the ck_fence_seconds-family signal the
+                # ROADMAP's eviction loop keys on.  Normalized by the
+                # window's total iteration count, same scale rule as the
+                # benches below and the transfer signal: a workload that
+                # grows its window 4x is not a 4x-slower lane, and an
+                # un-normalized feed would flip EVERY lane degraded on a
+                # pure cadence change
+                window_iters = max(1, sum(self._enqueue_iters.values()))
+                for w in self.workers:
+                    self.health.observe(
+                        w.index, "fence",
+                        (done_at[w.index] - t0) / window_iters)
+                FLIGHT.event("barrier", lanes={
+                    w.index: round((done_at[w.index] - t0) * 1000.0, 3)
+                    for w in self.workers
+                }, iters=window_iters)
                 iters_map = dict(self._enqueue_iters)
                 for w in self.workers:
                     bench = (done_at[w.index] - t0) * 1000.0
@@ -1855,6 +1994,9 @@ class Cores:
             REGISTRY.histogram(
                 "ck_barrier_seconds", "barrier wall time",
             ).observe(time.perf_counter() - _mt0)
+            # periodic metric sample into the flight ring (throttled —
+            # at most one per FLIGHT.sample_interval_s)
+            FLIGHT.maybe_sample_metrics()
             # always close the window — a fence failure must not leave a
             # stale t0/cid set to corrupt the NEXT window's benches
             self._enqueue_window_closed()
@@ -1873,6 +2015,9 @@ class Cores:
         return list(self.global_ranges.get(compute_id, []))
 
     def dispose(self) -> None:
+        if self._debug_server is not None:
+            self._debug_server.close()
+            self._debug_server = None
         for w in self.workers:
             w.dispose()
         self.pool.shutdown(wait=False)

@@ -297,6 +297,19 @@ class NumberCruncher:
     def performance_history(self, compute_id: int):
         return self.cores.performance_history(compute_id)
 
+    # -- live introspection (obs/) -------------------------------------------
+    def serve_debug(self, port: int = 0, host: str = "127.0.0.1"):
+        """Start the debug HTTP endpoints (``/metrics``, ``/statusz``,
+        ``/tracez``, ``/healthz``, ``/flightz``) on a daemon thread
+        (obs/debugserver.py).  ``port=0`` = ephemeral; read
+        ``server.port``.  Also auto-started by ``CK_DEBUG_PORT``."""
+        return self.cores.serve_debug(port=port, host=host)
+
+    def health_report(self) -> dict:
+        """Per-lane health verdicts (obs/health.py — advisory only):
+        ``{lane: {"verdict", "score", "evidence"}}``."""
+        return self.cores.health_report()
+
     def reset_errors(self) -> None:
         """Re-arm a cruncher after a compute failure (the reference has no
         reset — a failed cruncher stays dead; we allow explicit recovery)."""

@@ -339,14 +339,22 @@ class TransferTuner:
         and fall back to the link seed until re-observed."""
         with self._mu:
             if lane is None:
+                dropped = len(self._obs)
                 self._obs.clear()
                 self._last_choice.clear()
             else:
-                for k in [k for k in self._obs if k[0] == lane]:
+                doomed = [k for k in self._obs if k[0] == lane]
+                dropped = len(doomed)
+                for k in doomed:
                     del self._obs[k]
                 for k in [k for k in self._last_choice if k[0] == lane]:
                     del self._last_choice[k]
             self.retunes += 1
+        # flight-record the decision (outside the lock — the recorder is
+        # lock-free and must not nest under the tuner's mutex)
+        from ..obs.flight import FLIGHT
+
+        FLIGHT.event("stream-retune", lane=lane, dropped_keys=dropped)
 
     # -- the choice ----------------------------------------------------------
     def estimate(

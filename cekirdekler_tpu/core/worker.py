@@ -35,6 +35,7 @@ from jax import lax
 from ..arrays.clarray import ClArray
 from ..kernel.registry import KernelProgram
 from ..metrics.registry import REGISTRY
+from ..obs.flight import FLIGHT
 from ..trace.spans import TRACER
 from ..utils.markers import MarkerCounter
 
@@ -107,7 +108,7 @@ class _DriverQueue:
     sync point, never masquerade as fast device work — the barrier()
     error contract)."""
 
-    def __init__(self, depth_gauge=None):
+    def __init__(self, depth_gauge=None, name: str = "driver"):
         self._q: queue.Queue = queue.Queue()
         self._cond = threading.Condition()
         self._errors: list[Exception] = []
@@ -115,6 +116,7 @@ class _DriverQueue:
         # driver-FIFO occupancy gauge (metrics registry): queued +
         # executing closures, the fused path's host-side backlog
         self._depth_gauge = depth_gauge
+        self.name = name  # observability: which lane's which driver
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
 
@@ -139,8 +141,28 @@ class _DriverQueue:
             try:
                 fn()
             except Exception as e:  # noqa: BLE001 - re-raised at drain
+                # capture FIRST — the error contract (surfacing at the
+                # next submit/drain) outranks observability, and a
+                # broken __str__ in the instrumentation below must
+                # neither drop the error nor kill this daemon thread
+                # (a dead driver thread hangs every later drain)
                 with self._cond:
                     self._errors.append(e)
+                try:
+                    # observe the failure so the black box already holds
+                    # it when the caller's sync point re-raises and
+                    # triggers the postmortem dump
+                    FLIGHT.event(
+                        "driver-error", driver=self.name,
+                        exc_type=type(e).__name__, exc=str(e)[:500],
+                    )
+                    TRACER.instant("driver-error", tag=f"{self.name}: {e}")
+                    REGISTRY.counter(
+                        "ck_driver_errors_total",
+                        "dispatch-driver closure failures",
+                    ).inc()
+                except Exception:  # noqa: BLE001 - observing is optional
+                    pass
             finally:
                 with self._cond:
                     self._pending -= 1
@@ -436,7 +458,8 @@ class Worker:
         CALL — a runtime retune of the caller's knob applies to the next
         submit, not only to the queue's creation."""
         if self._driver is None:
-            self._driver = _DriverQueue(self._m_driver_depth)
+            self._driver = _DriverQueue(
+                self._m_driver_depth, name=f"fused:lane{self.index}")
         self._driver.submit(fn, depth)
 
     def drain_dispatch(self) -> None:
@@ -456,7 +479,8 @@ class Worker:
         drain).  ``depth`` bounds how many chunks the caller thread may
         stage ahead of the dispatched chunk — the double buffer."""
         if self._stream_driver is None:
-            self._stream_driver = _DriverQueue(self._m_stream_depth)
+            self._stream_driver = _DriverQueue(
+                self._m_stream_depth, name=f"stream:lane{self.index}")
         self._stream_driver.submit(fn, depth)
 
     def drain_stream_dispatch(self) -> None:

@@ -22,6 +22,7 @@ initialization.
 from .export import (
     chrome_counter_events,
     json_snapshot,
+    parse_prometheus_text,
     prometheus_from_snapshot,
     prometheus_text,
 )
@@ -44,6 +45,7 @@ __all__ = [
     "REGISTRY",
     "chrome_counter_events",
     "json_snapshot",
+    "parse_prometheus_text",
     "prometheus_from_snapshot",
     "prometheus_text",
     "series_name",

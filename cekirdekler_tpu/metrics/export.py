@@ -25,6 +25,7 @@ from .registry import REGISTRY, MetricsRegistry
 __all__ = [
     "prometheus_text",
     "prometheus_from_snapshot",
+    "parse_prometheus_text",
     "json_snapshot",
     "chrome_counter_events",
 ]
@@ -89,6 +90,59 @@ def prometheus_from_snapshot(snapshot: dict,
         lines.append(f"{_with_labels(name + '_sum', labels)} {v['sum']}")
         lines.append(f"{_with_labels(name + '_count', labels)} {v['count']}")
     return "\n".join(lines) + ("\n" if lines else "")
+
+
+def parse_prometheus_text(text: str) -> dict:
+    """The exposition format back into ``{"types": {name: kind},
+    "series": {series: value}}`` — the inverse the HTTP consumers need
+    (``tools/metrics_dump.py --watch`` polling a live ``/metrics``
+    endpoint, and the debug-server integration test's "parses as
+    Prometheus text" gate).  Histogram ``_bucket``/``_sum``/``_count``
+    lines ride as plain series.  Raises ``ValueError`` on a line that
+    is neither a comment nor a ``series value`` pair — a scrape that
+    half-parses must fail loudly, not render a half-table."""
+    types: dict[str, str] = {}
+    series: dict[str, float] = {}
+    for lineno, line in enumerate(text.splitlines(), 1):
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("#"):
+            parts = line.split()
+            if len(parts) >= 4 and parts[1] == "TYPE":
+                types[parts[2]] = parts[3]
+            continue
+        # the series name ends at the close of its label block (or the
+        # first bare space for label-less series — label VALUES may
+        # contain spaces, so brace depth decides, not split()); what
+        # follows is `value [timestamp]` per the exposition spec —
+        # splitting at the LAST space would eat the optional timestamp
+        # as the value and fold the real value into the series key
+        depth = 0
+        end = -1
+        for i, ch in enumerate(line):
+            if ch == "{":
+                depth += 1
+            elif ch == "}":
+                depth -= 1
+                if depth == 0:
+                    end = i + 1
+                    break
+            elif ch == " " and depth == 0:
+                end = i
+                break
+        if end < 0:
+            raise ValueError(f"unparseable exposition line {lineno}: {line!r}")
+        name = line[:end]
+        rest = line[end:].split()
+        if not rest or len(rest) > 2:  # value + optional timestamp only
+            raise ValueError(f"unparseable exposition line {lineno}: {line!r}")
+        try:
+            series[name] = float(rest[0])
+        except ValueError as e:
+            raise ValueError(
+                f"non-numeric sample on line {lineno}: {line!r}") from e
+    return {"types": types, "series": series}
 
 
 def prometheus_text(registry: MetricsRegistry | None = None) -> str:

@@ -1,0 +1,58 @@
+"""``cekirdekler_tpu.obs`` — the live introspection plane.
+
+Three pillars over the r7 tracer and r9 metrics registry (see
+``docs/OBSERVABILITY.md`` "Live introspection"):
+
+- :mod:`.debugserver` — stdlib-HTTP debug endpoints (``/metrics``,
+  ``/statusz``, ``/tracez``, ``/healthz``, ``/flightz``) served from a
+  daemon thread; start via ``Cores.serve_debug(port=0)`` or
+  ``CK_DEBUG_PORT``.
+- :mod:`.flight` — the always-on flight recorder: a bounded ring of
+  DECISION events (balancer moves, fused engage/disengage, stream-tuner
+  choices, driver failures) plus throttled metric samples, dumped as a
+  self-contained postmortem JSON (``CK_POSTMORTEM_DIR``) whenever a
+  crash surfaces at a wired boundary.
+- :mod:`.health` — rolling per-lane baselines over fence/transfer/
+  stream-stall walls with an N×-threshold + hysteresis degradation
+  detector; advisory verdicts only (``suggest_drain`` names lanes, the
+  elastic tier — ROADMAP item 4 — is the consumer that will act).
+
+No jax imports at module level — the plane costs no backend
+initialization (same contract as ``trace``/``metrics``).
+"""
+
+from .debugserver import DEBUG_PORT_ENV, DebugServer, serve_debug
+from .flight import (
+    FLIGHT,
+    POSTMORTEM_DIR_ENV,
+    FlightEvent,
+    FlightRecorder,
+    dump_postmortem,
+    load_postmortem,
+    postmortem_spans,
+    record_crash,
+)
+from .health import (
+    VERDICTS,
+    HealthMonitor,
+    cluster_health_table,
+    registry_health_summary,
+)
+
+__all__ = [
+    "DEBUG_PORT_ENV",
+    "DebugServer",
+    "FLIGHT",
+    "FlightEvent",
+    "FlightRecorder",
+    "HealthMonitor",
+    "POSTMORTEM_DIR_ENV",
+    "VERDICTS",
+    "cluster_health_table",
+    "dump_postmortem",
+    "load_postmortem",
+    "postmortem_spans",
+    "record_crash",
+    "registry_health_summary",
+    "serve_debug",
+]

@@ -349,8 +349,23 @@ class ClPipeline:
 
         # all stages compute concurrently on their current values
         futures = [self._pool.submit(st._run, st.kernels) for st in self.stages]
+        errs = []
         for f in futures:
-            f.result()
+            try:
+                f.result()
+            except Exception as e:  # noqa: BLE001 - first error surfaces
+                errs.append(e)
+        if errs:
+            # black box before the raise (obs/flight.py): a crashed
+            # pipeline generation dumps the flight/span/metrics state
+            # when CK_POSTMORTEM_DIR is armed
+            from ..obs.flight import record_crash
+
+            record_crash("pipeline.push", errs[0], lanes={
+                "stages": len(self.stages),
+                "push_count": self.push_count,
+            })
+            raise errs[0]
 
         # read back last stage's outputs (device→host)
         if results is not None:

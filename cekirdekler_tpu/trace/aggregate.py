@@ -90,6 +90,11 @@ class ClusterSnapshot(dict):
     - ``offsets``: per-process clock offsets (seconds, process 0 = 0.0)
     - ``spans``: per-process span lists ALIGNED to process 0's clock
     - ``metrics``: per-process registry snapshots
+    - ``health``: per-process lane-health reports
+      (``Cores.health_report()`` shape; ``{}`` for a process that
+      shipped none) — feed
+      :func:`cekirdekler_tpu.obs.health.cluster_health_table` for the
+      job-wide verdict table
     - ``nproc``
 
     (a dict subclass so it JSON-serializes untouched; spans are listed
@@ -118,22 +123,34 @@ def gather_cluster(
     metrics_snapshot: dict | None = None,
     rounds: int = 5,
     skew_s: float = 0.0,
+    health: dict | None = None,
 ) -> ClusterSnapshot:
-    """Ship this process's spans + metrics to the cluster; return the
-    merged, clock-aligned view (SPMD — every process receives the same
-    merge; process 0 is the canonical collector that persists it).
+    """Ship this process's spans + metrics + lane-health report to the
+    cluster; return the merged, clock-aligned view (SPMD — every
+    process receives the same merge; process 0 is the canonical
+    collector that persists it).
 
     Payloads are JSON over the raw-byte all-gather (rectangularized by
     padding to the max length — the same shape rule the result exchange
     uses).  ``skew_s`` shifts this process's span timestamps AND its
     probe clock by the same constant, the deterministic end-to-end test
-    of the estimator (see module docstring)."""
+    of the estimator (see module docstring).  ``health`` defaults to
+    the accelerator's own ``health_report()`` when it has one (the
+    ``DistributedAccelerator`` passthrough to its local ``Cores``) —
+    the DCN tier thereby sees every process's lane verdicts on one
+    table (``obs.health.cluster_health_table``)."""
     from ..metrics.registry import REGISTRY
 
     if spans is None:
         spans = TRACER.snapshot()
     if metrics_snapshot is None:
         metrics_snapshot = REGISTRY.snapshot()
+    if health is None:
+        reporter = getattr(acc, "health_report", None)
+        try:
+            health = reporter() if callable(reporter) else {}
+        except Exception:  # noqa: BLE001 - health must not sink the gather
+            health = {}
     offsets = estimate_clock_offsets(acc, rounds=rounds, skew_s=skew_s)
 
     rows = _spans_to_rows(spans)
@@ -142,7 +159,7 @@ def gather_cluster(
             r["t0"] += skew_s
             r["t1"] += skew_s
     payload = json.dumps(
-        {"spans": rows, "metrics": metrics_snapshot}
+        {"spans": rows, "metrics": metrics_snapshot, "health": health}
     ).encode()
     # rectangularize: exchange lengths first, pad to the max
     sizes = acc._allgather(np.asarray([len(payload)], np.int64)).reshape(-1)
@@ -153,16 +170,21 @@ def gather_cluster(
 
     per_proc_spans: list[list[Span]] = []
     per_proc_metrics: list[dict] = []
+    per_proc_health: list[dict] = []
     for p in range(len(sizes)):
         decoded = json.loads(
             gathered[p, : int(sizes[p])].tobytes().decode()
         )
         per_proc_spans.append(_rows_to_spans(decoded["spans"], offsets[p]))
         per_proc_metrics.append(decoded["metrics"])
+        # .get: a peer running a pre-health build ships no key — its
+        # absence stays visible as {} in the table, never an implied ok
+        per_proc_health.append(decoded.get("health") or {})
     return ClusterSnapshot(
         offsets=offsets,
         spans=per_proc_spans,
         metrics=per_proc_metrics,
+        health=per_proc_health,
         nproc=len(sizes),
     )
 

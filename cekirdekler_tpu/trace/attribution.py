@@ -90,6 +90,7 @@ class AttributionReport:
     device_busy_frac: float | None = None
     n_spans: int = 0
     ring_wrapped: bool = False
+    dropped_spans: int = 0     # spans lost to ring wrap (totals undercount)
 
     def to_dict(self) -> dict:
         return {
@@ -118,6 +119,7 @@ class AttributionReport:
             },
             "n_spans": self.n_spans,
             "ring_wrapped": self.ring_wrapped,
+            "dropped_spans": self.dropped_spans,
         }
 
     def table(self) -> str:
@@ -140,8 +142,8 @@ class AttributionReport:
             )
         if self.ring_wrapped:
             lines.append(
-                "(ring buffer wrapped: oldest spans overwritten — totals "
-                "undercount; raise Tracer capacity)"
+                f"(ring buffer wrapped: {self.dropped_spans} oldest spans "
+                "overwritten — totals undercount; raise Tracer capacity)"
             )
         return "\n".join(lines)
 
@@ -152,13 +154,18 @@ def window_report(
     t1: float,
     device_busy_ms: float | None = None,
     ring_wrapped: bool = False,
+    dropped_spans: int = 0,
 ) -> AttributionReport:
     """Account the host wall window [t0, t1] from recorded spans.
 
     Spans partially overlapping the window are clipped to it so a span
     straddling the boundary cannot inflate per-kind totals past the
     wall.  ``device_busy_ms`` (from ``timeline.analyze_trace_dir``)
-    rides along for the host-vs-device reconciliation."""
+    rides along for the host-vs-device reconciliation.
+    ``dropped_spans`` (``Tracer.dropped_spans``) is how many spans the
+    ring lost to wrap before this snapshot — when nonzero the report's
+    totals/coverage undercount by exactly those spans, and the report
+    says so instead of letting attribution coverage silently shrink."""
     wall_ms = max(t1 - t0, 0.0) * 1000.0
     per_kind: dict[str, dict] = {}
     per_cid: dict[int, dict] = {}
@@ -191,5 +198,6 @@ def window_report(
             if device_busy_ms is not None and wall_ms > 0 else None
         ),
         n_spans=n,
-        ring_wrapped=ring_wrapped,
+        ring_wrapped=ring_wrapped or dropped_spans > 0,
+        dropped_spans=dropped_spans,
     )

@@ -46,7 +46,10 @@ transfers so the two paths are distinguishable in every report),
 (fused-iteration window flush — spans tag ``xK`` for a K-iteration
 ladder dispatch; zero-duration instants tag ``disengage:<reason>`` when
 the fused path falls back to per-iteration dispatch, so a silent perf
-regression to the slow path is attributable).
+regression to the slow path is attributable), ``driver-error`` (a
+dispatch-driver closure failed — the instant is recorded at failure
+time, before the error surfaces at the caller's sync point, so a
+postmortem's span ring names the failing dispatch).
 """
 
 from __future__ import annotations
@@ -63,7 +66,7 @@ SPAN_KINDS = (
     "enqueue", "split", "rebalance", "launch", "fence",
     "upload", "download", "upload-chunk", "download-chunk",
     "pipeline-stage", "pool-task", "dcn-exchange",
-    "fused",
+    "fused", "driver-error",
 )
 
 
@@ -97,6 +100,10 @@ class Tracer:
         self._count = itertools.count()
         self._total = 0
         self._lock = threading.Lock()  # enable/clear only — never record()
+        # ring-wrap losses already exported to the metrics registry
+        # (ck_trace_dropped_spans_total) — the delta tracking that keeps
+        # the counter monotonic across snapshots within one ring epoch
+        self._dropped_reported = 0
 
     # -- recording (hot path) ------------------------------------------------
     def t0(self) -> float:
@@ -162,13 +169,18 @@ class Tracer:
 
     # -- control -------------------------------------------------------------
     def enable(self, capacity: int | None = None, clear: bool = True) -> None:
+        pending_drops = 0
         with self._lock:
+            # export wrap losses BEFORE any reset below zeroes the
+            # baseline: "raise Tracer capacity" (the report's own
+            # advice) must not silently eat the losses that motivated it
+            pending_drops = self._drop_delta_locked()
             if capacity is not None and capacity != self._cap:
                 # resizing rebuilds the ring; with clear=False the newest
                 # existing spans migrate so keep=True keeps its promise,
                 # and the counters restart so total_recorded/ring-wrap
                 # reporting describes the NEW buffer, not the old one
-                keep = [] if clear else self.snapshot()
+                keep = [] if clear else self._snapshot_locked_free()
                 self._cap = max(16, int(capacity))
                 self._clear_locked()
                 for s in keep[-self._cap:]:
@@ -178,18 +190,22 @@ class Tracer:
             elif clear:
                 self._clear_locked()
             self.enabled = True
+        self._inc_dropped(pending_drops)
 
     def disable(self) -> None:
         self.enabled = False
 
     def clear(self) -> None:
         with self._lock:
+            pending_drops = self._drop_delta_locked()
             self._clear_locked()
+        self._inc_dropped(pending_drops)
 
     def _clear_locked(self) -> None:
         self._buf = [None] * self._cap
         self._count = itertools.count()
         self._total = 0
+        self._dropped_reported = 0
 
     # -- inspection ----------------------------------------------------------
     @property
@@ -199,17 +215,67 @@ class Tracer:
         return self._total
 
     @property
+    def dropped_spans(self) -> int:
+        """Spans LOST to ring wrap since the last clear (oldest-first
+        overwrites) — the count every coverage report must carry:
+        attribution totals silently undercount by exactly these spans."""
+        return max(0, self._total - self._cap)
+
+    def _sync_dropped_metric(self) -> None:
+        """Export ring-wrap losses to ``ck_trace_dropped_spans_total``.
+        Called from snapshot() (a cold path) rather than record(): the
+        recording path's lock-free contract must not pay a registry
+        lock per span once the ring wraps.  Delta-based so the counter
+        stays monotonic across repeated snapshots; a clear() resets the
+        baseline with the ring.  The delta read-modify-write runs under
+        the tracer lock — two concurrent snapshots (the debug server's
+        /tracez thread + an in-process report) would otherwise both see
+        the same baseline and double-count the loss."""
+        with self._lock:
+            delta = self._drop_delta_locked()
+        self._inc_dropped(delta)
+
+    def _drop_delta_locked(self) -> int:
+        """Unreported ring-wrap loss; advances the baseline.  Caller
+        holds the tracer lock."""
+        d = self.dropped_spans
+        delta = d - self._dropped_reported
+        if delta <= 0:
+            return 0
+        self._dropped_reported = d
+        return delta
+
+    @staticmethod
+    def _inc_dropped(delta: int) -> None:
+        if delta <= 0:
+            return
+        from ..metrics.registry import REGISTRY
+
+        REGISTRY.counter(
+            "ck_trace_dropped_spans_total",
+            "spans lost to tracer ring wrap (attribution undercounts)",
+        ).inc(delta)
+
+    @property
     def capacity(self) -> int:
         return self._cap
+
+    def _snapshot_locked_free(self) -> list[Span]:
+        """The span copy alone — no metric sync, no lock.  enable()'s
+        keep-path calls this while HOLDING the tracer lock (snapshot()
+        there would deadlock on the non-reentrant lock via
+        _sync_dropped_metric)."""
+        buf = list(self._buf)  # one slice: consistent-enough view
+        spans = [s for s in buf if s is not None]
+        spans.sort(key=lambda s: s.t0)
+        return spans
 
     def snapshot(self) -> list[Span]:
         """Recorded spans, oldest first.  Concurrent recording during
         the snapshot may drop/duplicate a span at the wrap edge — the
         snapshot is for reporting, not for synchronization."""
-        buf = list(self._buf)  # one slice: consistent-enough view
-        spans = [s for s in buf if s is not None]
-        spans.sort(key=lambda s: s.t0)
-        return spans
+        self._sync_dropped_metric()
+        return self._snapshot_locked_free()
 
     def spans_between(self, t_lo: float, t_hi: float) -> list[Span]:
         """Spans that overlap the window [t_lo, t_hi]."""

@@ -567,6 +567,7 @@ def nbody_e2e(
                 lanes, probe_devs, n, dt, local_range, window,
                 probe_iters,
                 ring_wrapped=TRACER.total_recorded > TRACER.capacity,
+                dropped_spans=TRACER.dropped_spans,
                 single_chip_partitions=single_chip_partitions,
                 fused=fused,
             )
@@ -610,7 +611,7 @@ def _nbody_rig(n: int, prefix: str):
 def _nbody_attribution(
     spans, t0, t_end, wall, iters, lanes, probe_devs, n, dt,
     local_range, window, probe_iters, ring_wrapped=False,
-    single_chip_partitions=False, fused=True,
+    dropped_spans=0, single_chip_partitions=False, fused=True,
 ) -> dict:
     """Name each factor of the nbody_e2e gap with a measurement
     (VERDICT r5 #3).  Fractions are of the e2e wall; they need not sum
@@ -618,7 +619,8 @@ def _nbody_attribution(
     lane-interference factor is a ratio, not a time share."""
     from .trace.attribution import union_ms, window_report
 
-    rep = window_report(spans, t0, t_end, ring_wrapped=ring_wrapped)
+    rep = window_report(spans, t0, t_end, ring_wrapped=ring_wrapped,
+                        dropped_spans=dropped_spans)
 
     def _kind(kind):
         # the report's window-clipped totals — the same numbers its own
@@ -694,6 +696,7 @@ def _nbody_attribution(
             k: round(v["ms"], 3) for k, v in rep.per_kind.items()
         },
         "ring_wrapped": ring_wrapped,  # True = factors undercount
+        "dropped_spans": dropped_spans,  # exactly how many spans wrapped away
         "note": (
             "fracs are of e2e wall and overlap device time by design; "
             "window_rtt = barrier fences (sync cost per enqueue window), "

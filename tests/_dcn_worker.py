@@ -113,6 +113,33 @@ def main(pid: int, nproc: int, port: int, counts: list[int]) -> None:
         got = acc._broadcast0(mine)
         assert got.dtype == np.float64 and got[0] == 100.0, got
 
+        # ---- lane health: a deterministic 5x fence degradation on
+        # process 1's lane 0 (and ONLY there) must flip that lane to
+        # `degraded` locally, ship through gather_cluster's health
+        # payload, and appear in the DCN-merged cluster health table —
+        # the observation half of ROADMAP item 4's eviction loop.
+        # Injected samples (the skew_s convention: loopback rigs cannot
+        # produce real per-lane degradation deterministically); the few
+        # real transfer observations the 6 computes made cannot close a
+        # window (6 < window size), so the fence signal decides alone.
+        hm = acc.cruncher.cores.health
+        n_lanes = len(acc.cruncher.cores.workers)
+        for wnd in range(hm.min_history + hm.confirm + 1):
+            for _ in range(hm.window):
+                for lane in range(n_lanes):
+                    v = 0.010 * (1.0 + 0.1 * lane)  # unequal lanes are OK
+                    if pid == 1 and lane == 0 and wnd >= hm.min_history:
+                        v *= 5.0
+                    hm.observe(lane, "fence", v)
+        local = acc.health_report()
+        if pid == 1:
+            assert local[0]["verdict"] == "degraded", local
+            assert hm.suggest_drain() == [0], local
+            assert all(local[ln]["verdict"] == "ok"
+                       for ln in local if ln != 0), local
+        else:
+            assert all(r["verdict"] == "ok" for r in local.values()), local
+
         # ---- cluster aggregation: one merged timeline for the job ----
         from cekirdekler_tpu.metrics.registry import REGISTRY
         from cekirdekler_tpu.trace import aggregate
@@ -144,6 +171,16 @@ def main(pid: int, nproc: int, port: int, counts: list[int]) -> None:
         # still catching an uncancelled skew (>= 7.5 s) 30x over.
         margin = aggregate.collective_consistency(snap)
         assert margin > -0.25, f"merged trace inconsistent: {margin}"
+        # the DCN-merged cluster health table: process 1's degraded lane
+        # 0 appears (JSON round-trip stringifies lane keys), every other
+        # process reads ok, and absence would be visible (not implied ok)
+        from cekirdekler_tpu.obs.health import cluster_health_table
+
+        table = cluster_health_table(snap)
+        assert len(table["processes"]) == nproc, table
+        deg = {(d["process"], str(d["lane"])) for d in table["degraded"]}
+        assert deg == {(1, "0")}, table
+        assert table["worst"] == "degraded", table
         merged = aggregate.merged_chrome_trace(snap)
         pids = {e["pid"] for e in merged["traceEvents"]}
         assert pids == set(range(1, nproc + 1)), pids

@@ -1,0 +1,104 @@
+"""tools/lint_obs.py as a tier-1 gate: docs/OBSERVABILITY.md and the
+code's observability surface (registered ``ck_*`` series, SPAN_KINDS)
+may not drift — this test IS the enforcement, so a PR adding an
+undocumented metric (or documenting a removed one) fails here with the
+diff.  Plus unit pins on the linter's own extraction rules, and the
+``tools/metrics_dump.py --watch`` HTTP poller against a live debug
+server."""
+
+import importlib.util
+import os
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+ROOT = os.path.dirname(HERE)
+
+
+def _load(name, relpath):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(ROOT, relpath))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+lint = _load("ck_lint_obs", "tools/lint_obs.py")
+
+
+# ---------------------------------------------------------------------------
+# the gate itself
+# ---------------------------------------------------------------------------
+
+def test_doc_and_code_observability_surfaces_agree():
+    problems = lint.run()
+    assert problems == [], "\n".join(problems)
+
+
+def test_lint_inventories_are_nonempty():
+    # a regex that silently matched nothing would make the gate vacuous
+    assert len(lint.code_metric_names()) >= 20
+    assert len(lint.code_span_kinds()) >= 10
+
+
+# ---------------------------------------------------------------------------
+# extraction-rule unit pins
+# ---------------------------------------------------------------------------
+
+def test_doc_metric_extraction_drops_truncated_prefixes():
+    text = "uses `ck_upload_bytes_total` and files ck_postmortem_<pid>.json"
+    assert lint.doc_metric_names(text) == {"ck_upload_bytes_total"}
+
+
+def test_doc_metric_extraction_collapses_exposition_suffixes():
+    text = "`ck_fence_seconds` renders `ck_fence_seconds_bucket` lines"
+    assert lint.doc_metric_names(text) == {"ck_fence_seconds"}
+
+
+def test_doc_span_kind_table_extraction():
+    text = (
+        "## The tracer (x)\n"
+        "| kind | layer |\n"
+        "| `enqueue` | cores |\n"
+        "| `upload-chunk`   | worker |\n"
+        "not-a-row `fused`\n"
+        "## Next section\n"
+    )
+    assert lint.doc_span_kinds(text) == {"enqueue", "upload-chunk"}
+
+
+def test_span_kinds_parsed_statically_match_import():
+    from cekirdekler_tpu.trace.spans import SPAN_KINDS
+
+    assert lint.code_span_kinds() == set(SPAN_KINDS)
+
+
+# ---------------------------------------------------------------------------
+# metrics_dump --watch: poll a live debug server over HTTP
+# ---------------------------------------------------------------------------
+
+def test_metrics_dump_watch_polls_live_endpoint(capsys):
+    from cekirdekler_tpu.metrics import REGISTRY
+    from cekirdekler_tpu.obs.debugserver import DebugServer
+
+    # guarantee a lane-labeled series exists whatever ran before
+    REGISTRY.counter(
+        "ck_upload_bytes_total", "H2D bytes uploaded", lane=0).inc(0)
+    srv = DebugServer(cores=None, port=0)
+    try:
+        md = _load("ck_metrics_dump", "tools/metrics_dump.py")
+        rc = md.main([
+            "--url", srv.url + "/metrics", "--watch", "0.05", "--count", "2",
+        ])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert out.count("lane") >= 2            # two rendered polls
+        assert "health" in out and "up/s" in out  # the top-like columns
+    finally:
+        srv.close()
+
+
+def test_metrics_dump_watch_requires_url():
+    import pytest
+
+    md = _load("ck_metrics_dump2", "tools/metrics_dump.py")
+    with pytest.raises(SystemExit):
+        md.main(["--watch", "1"])

@@ -493,3 +493,50 @@ def test_annotate_nulls_replaces_bare_nulls_only():
     assert result["dtype_sweepish"]["budget_spent_s"] == 90.0
     assert result["nbody"] == {"gpairs_per_sec": 0.0}  # real value kept
     assert result["untouched"] is None  # not a recorded section
+
+
+# ---------------------------------------------------------------------------
+# --history: the per-key trajectory table
+# ---------------------------------------------------------------------------
+
+def _write_round(root, n, headline):
+    path = os.path.join(root, f"BENCH_r{n:02d}.json")
+    with open(path, "w") as f:
+        json.dump({"headline": headline}, f)
+    return path
+
+
+def test_history_table_values_cv_and_tolerance(tmp_path):
+    root = str(tmp_path)
+    # three rounds so the CV column engages for a stable key; one key
+    # goes null in the last round and must render as null, not vanish
+    for n, mpix in ((1, 240.0), (2, 250.0), (3, 245.0)):
+        h = dict(HEADLINE)
+        h["mandelbrot_mpix"] = mpix
+        if n == 3:
+            h["vs_tuned_loop"] = None
+        _write_round(root, n, h)
+    table = regress.history_table(root)
+    lines = table.splitlines()
+    assert lines[0].split()[:1] == ["key"]
+    assert "r01" in lines[0] and "r03" in lines[0]
+    assert "CV" in lines[0] and "tol" in lines[0]
+    mandel = next(ln for ln in lines if ln.startswith("mandelbrot_mpix"))
+    assert "240" in mandel and "250" in mandel and "245" in mandel
+    tuned = next(ln for ln in lines if ln.startswith("vs_tuned_loop"))
+    assert "null" in tuned
+    # stable trajectory: CV small, tolerance stays at the floor (0.10)
+    cv, tol = mandel.split()[-2:]
+    assert float(cv) < 0.05 and float(tol) == 0.1
+
+
+def test_history_table_empty_root(tmp_path):
+    assert "no BENCH_r*.json" in regress.history_table(str(tmp_path))
+
+
+def test_main_history_flag_short_circuits(tmp_path, capsys):
+    _write_round(str(tmp_path), 1, HEADLINE)
+    rc = regress.main(["--history", "--root", str(tmp_path)])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "mandelbrot_mpix" in out and "tol" in out

@@ -1,0 +1,153 @@
+#!/usr/bin/env python
+"""Static observability-surface lint: docs/OBSERVABILITY.md and the code
+may not drift apart.
+
+Two inventories, compared both ways, no imports (pure source scanning —
+the lint can run anywhere, including rigs where jax is broken):
+
+- **Metric names.**  Every ``ck_*`` series registered in
+  ``cekirdekler_tpu/`` (literal first arguments of
+  ``REGISTRY.counter/gauge/histogram`` calls) must appear in
+  docs/OBSERVABILITY.md, and every ``ck_*`` token the doc mentions must
+  be registered somewhere — a doc describing a metric that no longer
+  exists is worse than no doc.
+- **Span kinds.**  The ``SPAN_KINDS`` tuple in ``trace/spans.py``
+  (parsed with ``ast``, not imported) must match the kind table in the
+  doc's tracer section exactly, both directions.
+
+Exit 0 clean; exit 1 with the diff printed.  Runs as a tier-1 test
+(``tests/test_lint_obs.py``), so a PR adding a ``ck_`` series without
+documenting it — or documenting one it didn't add — fails CI.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DOC = os.path.join(REPO, "docs", "OBSERVABILITY.md")
+PKG = os.path.join(REPO, "cekirdekler_tpu")
+SPANS_PY = os.path.join(PKG, "trace", "spans.py")
+
+#: Registration call pattern: REGISTRY.counter("ck_x", ...) — the first
+#: argument is always a string literal in this codebase (the lint EXISTS
+#: to keep it that way: a computed name cannot be statically checked).
+_REG_RE = re.compile(
+    r"REGISTRY\s*\.\s*(?:counter|gauge|histogram)\(\s*\n?\s*"
+    r"[\"'](ck_[a-z0-9_]+)[\"']"
+)
+
+_DOC_NAME_RE = re.compile(r"\bck_[a-z0-9_]+\b")
+
+#: Doc tokens that are NOT metric series: derived Prometheus-exposition
+#: suffix lines a doc may legitimately show.
+_EXPOSITION_SUFFIXES = ("_bucket", "_sum", "_count")
+
+
+def code_metric_names() -> set[str]:
+    names: set[str] = set()
+    for root, _dirs, files in os.walk(PKG):
+        for fn in files:
+            if not fn.endswith(".py"):
+                continue
+            with open(os.path.join(root, fn)) as f:
+                names.update(_REG_RE.findall(f.read()))
+    return names
+
+
+def doc_metric_names(doc_text: str) -> set[str]:
+    # a trailing underscore is a truncated prefix (e.g. the postmortem
+    # FILENAME pattern ck_postmortem_<pid>), not a series name
+    names = {
+        n for n in _DOC_NAME_RE.findall(doc_text) if not n.endswith("_")
+    }
+    # strip exposition-suffix forms when their base series is also named
+    out = set()
+    for n in names:
+        base = n
+        for suf in _EXPOSITION_SUFFIXES:
+            if n.endswith(suf) and n[: -len(suf)] in names:
+                base = None
+                break
+        if base:
+            out.add(n)
+    return out
+
+
+def code_span_kinds() -> set[str]:
+    """``SPAN_KINDS`` parsed out of trace/spans.py without importing."""
+    tree = ast.parse(open(SPANS_PY).read())
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == "SPAN_KINDS":
+                    return set(ast.literal_eval(node.value))
+    raise AssertionError("SPAN_KINDS tuple not found in trace/spans.py")
+
+
+def doc_span_kinds(doc_text: str) -> set[str]:
+    """First-cell backticked tokens of the kind table in the tracer
+    section (rows look like ``| `enqueue`        | cores ... |``)."""
+    m = re.search(r"## The tracer(.*?)(?:\n## )", doc_text, re.S)
+    if not m:
+        raise AssertionError(
+            "docs/OBSERVABILITY.md has no '## The tracer' section")
+    kinds = set()
+    for line in m.group(1).splitlines():
+        cell = re.match(r"\|\s*`([a-z0-9-]+)`\s*\|", line)
+        if cell:
+            kinds.add(cell.group(1))
+    if not kinds:
+        raise AssertionError("no span-kind table rows found in the doc")
+    return kinds
+
+
+def run() -> list[str]:
+    """All drift findings (empty = clean)."""
+    doc_text = open(DOC).read()
+    problems: list[str] = []
+
+    code_m, doc_m = code_metric_names(), doc_metric_names(doc_text)
+    for name in sorted(code_m - doc_m):
+        problems.append(
+            f"metric {name} is registered in code but absent from "
+            "docs/OBSERVABILITY.md"
+        )
+    for name in sorted(doc_m - code_m):
+        problems.append(
+            f"metric {name} is documented but registered nowhere under "
+            "cekirdekler_tpu/"
+        )
+
+    code_k, doc_k = code_span_kinds(), doc_span_kinds(doc_text)
+    for kind in sorted(code_k - doc_k):
+        problems.append(
+            f"span kind '{kind}' is in trace.spans.SPAN_KINDS but missing "
+            "from the doc's kind table"
+        )
+    for kind in sorted(doc_k - code_k):
+        problems.append(
+            f"span kind '{kind}' is in the doc's kind table but not in "
+            "trace.spans.SPAN_KINDS"
+        )
+    return problems
+
+
+def main(argv=None) -> int:
+    problems = run()
+    if problems:
+        print(f"lint_obs: {len(problems)} doc/code drift finding(s):")
+        for p in problems:
+            print(f"  - {p}")
+        return 1
+    print("lint_obs: docs/OBSERVABILITY.md and code agree "
+          f"({len(code_metric_names())} metrics, "
+          f"{len(code_span_kinds())} span kinds)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -68,6 +68,7 @@ def timed_segment(label, fn_iter, fence_out, n, iters, warmup, sync_every,
         rep = window_report(
             spans, t_lo, t_hi,
             ring_wrapped=tracer.total_recorded > tracer.capacity,
+            dropped_spans=tracer.dropped_spans,
         )
         print("  -- attribution " + "-" * 56)
         for line in rep.table().splitlines():

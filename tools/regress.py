@@ -34,6 +34,7 @@ Usage::
 
     python tools/regress.py --against BENCH_r05.json [--candidate F]
     python tools/regress.py --against BENCH_r05.json --json
+    python tools/regress.py --history        # per-key trajectory table
 
 With no ``--candidate``, the newest ``BENCH_r*.json`` other than
 ``--against`` is the candidate.  ``bench.py`` also runs this in-process
@@ -56,6 +57,7 @@ __all__ = [
     "load_headline",
     "diff_headlines",
     "bench_epilogue",
+    "history_table",
     "main",
 ]
 
@@ -333,10 +335,54 @@ def bench_epilogue(result: dict, repo_root: str) -> dict | None:
         return {"ok": None, "error": f"{type(e).__name__}: {e}"[:300]}
 
 
+def history_table(root: str, watched=WATCHED_KEYS) -> str:
+    """Compact per-key trajectory table over the on-disk ``BENCH_r*``
+    artifacts: one row per watched key, one column per round, plus the
+    trajectory CV and the effective (noise-widened) tolerance — bench
+    regressions eyeballed without opening five JSON files."""
+    paths = _artifact_paths(root)
+    if not paths:
+        return f"(no BENCH_r*.json artifacts under {root})"
+    history = [load_headline(p) for p in paths]
+    rounds = []
+    for p in paths:
+        m = re.search(r"BENCH_r(\d+)", os.path.basename(p))
+        rounds.append(f"r{m.group(1)}" if m else os.path.basename(p)[:8])
+    key_w = max(len(k) for k, *_ in watched)
+    col_w = max(8, max(len(r) for r in rounds) + 1)
+    lines = [
+        f"{'key':<{key_w}} "
+        + "".join(f"{r:>{col_w}}" for r in rounds)
+        + f" {'CV':>7} {'tol':>7}"
+    ]
+    heads = [h.get("headline") or {} for h in history]
+    for key, aliases, _direction, floor in watched:
+        vals = [_get(h, key, aliases) for h in heads]
+        if all(v is None for v in vals):
+            continue
+
+        def cell(v):
+            if v is None:
+                return f"{'null':>{col_w}}"
+            return f"{v:>{col_w}.4g}"
+
+        cv = _trajectory_cv(heads, key, aliases)
+        tol = max(floor, NOISE_K * cv) if cv is not None else floor
+        cv_cell = f"{cv:>7.3f}" if cv is not None else f"{'-':>7}"
+        lines.append(
+            f"{key:<{key_w}} " + "".join(cell(v) for v in vals)
+            + f" {cv_cell} {tol:>7.3f}"
+        )
+    return "\n".join(lines)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
-    ap.add_argument("--against", required=True,
+    ap.add_argument("--against", default=None,
                     help="baseline artifact (e.g. BENCH_r05.json)")
+    ap.add_argument("--history", action="store_true",
+                    help="print the per-key trajectory table (value per "
+                         "round + CV + effective tolerance) and exit")
     ap.add_argument("--candidate", default=None,
                     help="candidate artifact or raw bench output "
                          "(default: newest BENCH_r*.json != --against)")
@@ -349,6 +395,11 @@ def main(argv=None) -> int:
 
     root = args.root or os.path.dirname(
         os.path.dirname(os.path.abspath(__file__)))
+    if args.history:
+        print(history_table(root))
+        return 0
+    if not args.against:
+        ap.error("--against is required (or use --history)")
     baseline = load_headline(args.against)
     if baseline["headline"] is None:
         print(f"regress: no headline in baseline {args.against}",
