@@ -296,8 +296,10 @@ def _guard(fn) -> dict:
 def main() -> None:
     import jax
 
+    from .utils.jsonsafe import dumps_safe
+
     if jax.default_backend() != "cpu" or len(jax.devices()) < 8:
-        print(json.dumps({
+        print(dumps_safe({
             "ok": False,
             "error": f"rig not available: backend={jax.default_backend()} "
                      f"n={len(jax.devices())}",
@@ -352,7 +354,7 @@ def main() -> None:
         enq_traj[i] != enq_traj[i - 1] for i in (4, 8)
     )
 
-    print(json.dumps({
+    print(dumps_safe({
         "ok": True,
         "n_devices": len(devs),
         "live_convergence_iters": res.convergence_iters,

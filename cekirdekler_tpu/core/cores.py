@@ -181,7 +181,14 @@ class Cores:
         self.fused_dispatch = True
         self.fused_batch = 16
         self.fused_queue_depth = 2
+        # window identity/state: ALL writes hold self._lock; compute()'s
+        # fast path reads them lock-free (one attribute read per enqueue
+        # call) and _fused_defer revalidates under the lock before
+        # counting — the stale-read window is the design, the lock'd
+        # revalidation is the correctness
+        # ckcheck: ok racy fast-path read, revalidated in _fused_defer
         self._fused_sig: tuple | None = None
+        # ckcheck: ok racy fast-path read, revalidated in _fused_defer
         self._fused_run: _FusedRun | None = None
         # last per-call enqueue signature: a window engages only on a
         # CONSECUTIVE repeat, so a window that never repeats (mixed cids
@@ -360,6 +367,8 @@ class Cores:
             else:
                 ranges = equal_split(total, n, step)
         elif rebalance and n > 1 and self.fixed_compute_powers is None:
+            # ckcheck: ok racy bench read — staleness tolerated by the
+            # balancer (decay/refresh converge it); writers hold w.lock
             bench = [w.benchmarks.get(compute_id, 0.0) for w in self.workers]
             if all(b > 0 for b in bench):
                 hist = None
@@ -374,6 +383,7 @@ class Cores:
                 # bench alone would justify (unequal effective link
                 # bandwidth, the reference's multi-GPU PCIe reality)
                 transfer = [
+                    # ckcheck: ok racy bench read — same contract as above
                     w.transfer_benchmarks.get(compute_id, 0.0)
                     for w in self.workers
                 ]
@@ -473,9 +483,16 @@ class Cores:
                 if mode_change:
                     # clear the candidate so this call's tail records ONE
                     # event ("mode-change"), not a second engage-refusal
-                    # under another name for the same call
-                    self._fused_candidate = None
+                    # under another name for the same call.  Under the
+                    # lock: the candidate is written by concurrent host
+                    # threads' engage tails (ckcheck lockset finding —
+                    # an unlocked clear could resurrect a candidate
+                    # another thread just replaced)
+                    with self._lock:
+                        self._fused_candidate = None
                     self._fused_break("mode-change")
+                # ckcheck: ok one-shot arm: a stale read only delays the
+                # rebalance by one call; arm/disarm writes hold _lock
                 elif compute_id in self._enqueue_rebalance:
                     # a barrier armed a rebalance: ranges may move — the
                     # window's pinned per-device rows are no longer valid
@@ -509,9 +526,13 @@ class Cores:
             global_range,
             step,
             rebalance=(not self.enqueue_mode)
+            # ckcheck: ok one-shot arm — same contract as the check above
             or compute_id in self._enqueue_rebalance,
         )
-        self._enqueue_rebalance.discard(compute_id)
+        with self._lock:
+            # same lock as barrier's |= : a discard interleaved into the
+            # set union would un-arm a rebalance the barrier just armed
+            self._enqueue_rebalance.discard(compute_id)
         if ranges != old_ranges:
             TRACER.instant(
                 "split" if not old_ranges else "rebalance",
@@ -580,12 +601,19 @@ class Cores:
         # compile) cannot starve it permanently.  The transfer floor decays
         # with it — a zero-range lane moves no bytes either, so a transient
         # link hiccup would otherwise pin max(bench, transfer) at the stale
-        # link cost forever no matter how far the compute bench decays
+        # link cost forever no matter how far the compute bench decays.
+        # Under the worker lock: the `*=` read-modify-write races a driver
+        # thread's end_bench / a concurrent flush's transfer feed — an
+        # interleaved store loses one side's update (ckcheck lockset
+        # finding, PR 7; the bench dicts' writers all hold w.lock now)
         for i, w in enumerate(self.workers):
-            if ranges[i] <= 0 and w.benchmarks.get(compute_id, 0.0) > 0.0:
-                w.benchmarks[compute_id] *= 0.5
-            if ranges[i] <= 0 and w.transfer_benchmarks.get(compute_id, 0.0) > 0.0:
-                w.transfer_benchmarks[compute_id] *= 0.5
+            if ranges[i] > 0:
+                continue
+            with w.lock:
+                if w.benchmarks.get(compute_id, 0.0) > 0.0:
+                    w.benchmarks[compute_id] *= 0.5
+                if w.transfer_benchmarks.get(compute_id, 0.0) > 0.0:
+                    w.transfer_benchmarks[compute_id] *= 0.5
 
         # write_all owner: "device i writes array (i mod numDevices)"
         # (Worker.cs:871-885) — but only among chips that actually run,
@@ -657,6 +685,7 @@ class Cores:
     ) -> None:
         perf = ComputePerf(
             compute_id=compute_id,
+            # ckcheck: ok racy bench read — reporting only
             device_ms=[w.benchmarks.get(compute_id, 0.0) for w in self.workers],
             device_items=list(ranges),
             total_ms=(time.perf_counter() - t_start) * 1000.0,
@@ -740,7 +769,12 @@ class Cores:
             kernel_names, params, compute_id, global_range,
             local_range, global_offset, value_args,
         )
-        candidate, self._fused_candidate = self._fused_candidate, sig
+        # swap under the scheduler lock: with concurrent host threads the
+        # unlocked read-modify-write could interleave with another
+        # thread's swap and engage a window off a candidate that thread
+        # already replaced (ckcheck lockset finding, PR 7)
+        with self._lock:
+            candidate, self._fused_candidate = self._fused_candidate, sig
         if not self._sig_equal(sig, candidate):
             return
         reason = None
@@ -770,6 +804,7 @@ class Cores:
                     continue
                 off = global_offset + refs[i]
                 rows.append((w, off, ranges[i]))
+                # ckcheck: ok monotone epoch int — one GIL-atomic read
                 epochs.append((w, w.coverage_epoch))
                 for p in params:
                     fl = p.flags
@@ -815,10 +850,15 @@ class Cores:
         self._m_fused_deferred.inc()
         if pending >= max(1, int(self.fused_batch)):
             self._fused_flush()
-        TRACER.record(
-            "enqueue", t_start, cid=cid,
-            tag="+".join(kernel_names) + " fused-defer",
-        )
+        if TRACER.enabled:
+            # guard the WHOLE call: the tag concatenation allocates per
+            # deferral even when the tracer is off, and the deferral is
+            # the path whose cost budget is "a counter increment"
+            # (ckcheck hotpath finding, PR 7)
+            TRACER.record(
+                "enqueue", t_start, cid=cid,
+                tag="+".join(kernel_names) + " fused-defer",
+            )
         self._record_perf(cid, t_start, self.global_ranges.get(cid, []))
         return True
 
@@ -863,6 +903,7 @@ class Cores:
         FLIGHT.event("fused-window", cid=run.compute_id, iters=iters)
         TRACER.record("fused", _tt, cid=run.compute_id, tag=f"x{iters}")
 
+    # ckcheck: cold window boundary — runs once per fused_batch deferrals
     def _fused_flush(self) -> None:
         """Dispatch the accumulated deferred iterations (window stays
         open).  Under _fused_mu so a concurrent close cannot drain the
@@ -1755,7 +1796,12 @@ class Cores:
             )
         for (w, cid), s in acc.items():
             per_iter_s = s / max(1, iters.get(cid, 1))
-            w.transfer_benchmarks[cid] = per_iter_s * 1000.0
+            # under the worker lock (RLock — the atomic rebalance flush
+            # already holds it): flush() runs on the caller thread with
+            # no worker lock, so this store raced a concurrent enqueue
+            # thread's in-phase transfer feed (ckcheck lockset finding)
+            with w.lock:
+                w.transfer_benchmarks[cid] = per_iter_s * 1000.0
             # lane health rides the same per-iteration normalization the
             # balancer floor uses, so windows of different sizes feed
             # one scale (a 4x-bigger window is not a 4x-slower link)
@@ -1845,6 +1891,7 @@ class Cores:
             "enqueue_mode": self.enqueue_mode,
             "fused_dispatch": self.fused_dispatch,
             "streamed_transfers": self.streamed_transfers,
+            # ckcheck: ok racy snapshot copy — reporting only
             "stream_chunks": dict(self.last_stream_chunks),
         }
 
@@ -1857,6 +1904,7 @@ class Cores:
         return text
 
     def benchmarks_of(self, compute_id: int) -> list[float]:
+        # ckcheck: ok racy bench read — reporting only
         return [w.benchmarks.get(compute_id, 0.0) for w in self.workers]
 
     def performance_history(self, compute_id: int) -> list[ComputePerf]:
@@ -1915,11 +1963,21 @@ class Cores:
         ).inc()
         _mt0 = time.perf_counter()
         t_b = TRACER.t0()
-        t0 = self._enqueue_t0
+        # ONE consistent snapshot of the window state under the lock:
+        # another host thread's compute() mutates t0 / the cid order /
+        # the iteration counts mid-barrier, and the previous unlocked
+        # point reads could see a half-updated window (cid added to the
+        # set, iteration count not yet bumped) and feed the balancer a
+        # mismatched divisor (ckcheck lockset finding, PR 7)
+        with self._lock:
+            t0 = self._enqueue_t0
+            window_cids = set(self._enqueue_cids)
+            window_cid_order = list(self._enqueue_cid_order)
+            window_iters_map = dict(self._enqueue_iters)
         measure = self.enqueue_mode and t0 is not None and len(self.workers) > 1
         split_order = (
-            list(self._enqueue_cid_order)
-            if (self.fence_split and measure and len(self._enqueue_cids) > 1)
+            window_cid_order
+            if (self.fence_split and measure and len(window_cids) > 1)
             else []
         )
         try:
@@ -1962,7 +2020,7 @@ class Cores:
                 # grows its window 4x is not a 4x-slower lane, and an
                 # un-normalized feed would flip EVERY lane degraded on a
                 # pure cadence change
-                window_iters = max(1, sum(self._enqueue_iters.values()))
+                window_iters = max(1, sum(window_iters_map.values()))
                 for w in self.workers:
                     self.health.observe(
                         w.index, "fence",
@@ -1971,13 +2029,12 @@ class Cores:
                     w.index: round((done_at[w.index] - t0) * 1000.0, 3)
                     for w in self.workers
                 }, iters=window_iters)
-                iters_map = dict(self._enqueue_iters)
                 for w in self.workers:
                     bench = (done_at[w.index] - t0) * 1000.0
                     splits = split_fence_benches(comp_at.get(w.index, ()), t0)
                     window_ms = {
                         cid: splits.get(cid, bench)
-                        for cid in self._enqueue_cids
+                        for cid in window_cids
                         # only chips that ran this id refresh its bench;
                         # split marginals when available, whole-window
                         # fence time otherwise (the documented default)
@@ -1985,10 +2042,18 @@ class Cores:
                             cid, [1] * len(self.workers)
                         )[w.index] > 0
                     }
-                    w.benchmarks.update(
-                        per_iteration_benches(window_ms, iters_map)
-                    )
-                self._enqueue_rebalance |= self._enqueue_cids
+                    # under the worker lock: a driver thread's end_bench
+                    # holds it — an unlocked update here could be lost
+                    # against (or lose) that write (ckcheck finding)
+                    with w.lock:
+                        w.benchmarks.update(
+                            per_iteration_benches(window_ms, window_iters_map)
+                        )
+                # |= is a read-modify-write on the shared set; a
+                # concurrent compute()'s discard must not be interleaved
+                # into it (ckcheck lockset finding)
+                with self._lock:
+                    self._enqueue_rebalance |= window_cids
             TRACER.record("fence", t_b, tag="barrier")
         finally:
             REGISTRY.histogram(

@@ -165,6 +165,7 @@ class NumberCruncher:
     def fused_stats(self) -> dict:
         """Fused-dispatch observability: windows dispatched, iterations
         fused/deferred, and per-reason disengage counts."""
+        # ckcheck: ok racy snapshot read — reporting only
         return self.cores.fused_stats
 
     @property

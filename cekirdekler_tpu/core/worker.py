@@ -202,13 +202,22 @@ class Worker:
         # compute ids touching one array lose updates, and fence() would
         # iterate the dict while another lane inserts.
         self.lock = threading.RLock()
-        # array-object → device buffer (reference: Worker.cs:194)
+        # array-object → device buffer (reference: Worker.cs:194).
+        # Buffer/coverage state is guarded by PROTOCOL, not by a lock the
+        # analyzer can see: while a phase holds self.lock, either the
+        # phase thread mutates these dicts directly, or it delegates to
+        # the stream/fused driver thread and BLOCKS (stage/submit
+        # backpressure, drain) without touching them — single writer at
+        # every instant, see stream_dispatch_async
+        # ckcheck: ok single-writer stream/fused driver protocol
         self._buffers: dict[int, Any] = {}
+        # ckcheck: ok single-writer stream/fused driver protocol
         self._buffer_owner: dict[int, ClArray] = {}  # strong refs, like the reference
         # array-object → (offset, size) element range this chip has uploaded;
         # enqueue mode skips a re-upload only when the requested range is
         # covered — so the balancer may MOVE ranges between syncs and the
         # newly-acquired region is fetched instead of silently served stale
+        # ckcheck: ok single-writer stream/fused driver protocol
         self._uploaded: dict[int, tuple[int, int]] = {}
         # per-compute-id accumulated wall ms (reference: Worker.cs:190,753-807)
         self.benchmarks: dict[int, float] = {}
@@ -227,7 +236,10 @@ class Worker:
         # "staged-dma") — observability for the zero_copy flag
         self.last_upload_path: str | None = None
         # fine-grained progress markers (reference: queue markers,
-        # ClCommandQueue.cs:99-115); None unless enabled by the cruncher
+        # ClCommandQueue.cs:99-115); None unless enabled by the cruncher —
+        # toggled only while the lane is quiescent (no phase in flight),
+        # the fine_grained_queue_control contract
+        # ckcheck: ok toggled quiescent; MarkerCounter locks internally
         self.markers: MarkerCounter | None = None
         # per-compute-id LAST output value of the most recent launch —
         # materializing it retires exactly when that cid's final kernel
@@ -237,6 +249,10 @@ class Worker:
         # propagates it): each record pins a device buffer until the cid
         # cycles out, a cost only the split should pay.
         self.track_cid_outputs = False
+        # launch-path writes ride the driver protocol above; barrier's
+        # fence_cid reads run AFTER the drivers drained (no concurrent
+        # writer), and the fence_split-off clear holds self.lock
+        # ckcheck: ok single-writer driver protocol + post-drain reads
         self._cid_last_out: dict[int, Any] = {}
         # coverage epoch: bumped by every reset_coverage().  The fused
         # dispatch path (core/cores.py) snapshots it at window engage and

@@ -46,6 +46,7 @@ from urllib.parse import parse_qs, urlparse
 from ..metrics.export import prometheus_text
 from ..metrics.registry import REGISTRY
 from ..trace.spans import TRACER
+from ..utils.jsonsafe import json_safe
 from .flight import FLIGHT
 
 __all__ = ["DebugServer", "serve_debug", "DEBUG_PORT_ENV"]
@@ -57,7 +58,11 @@ TRACEZ_ROWS = 256
 
 
 def _json_bytes(obj) -> bytes:
-    return json.dumps(obj, default=str).encode()
+    # json_safe: a float('inf') ANYWHERE in a payload (a gauge a caller
+    # set, a weird tag) must degrade to null, never serialize as the
+    # RFC-8259-invalid bare `Infinity` every strict scraper rejects —
+    # the generalized PR 6 /healthz fix (ckcheck invariant/json-unsafe)
+    return json.dumps(json_safe(obj), allow_nan=False).encode()
 
 
 def _copy_dict(d: dict) -> dict:

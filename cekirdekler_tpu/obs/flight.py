@@ -63,6 +63,7 @@ __all__ = [
     "FlightEvent",
     "FlightRecorder",
     "FLIGHT",
+    "EVENT_KINDS",
     "POSTMORTEM_DIR_ENV",
     "dump_postmortem",
     "load_postmortem",
@@ -71,6 +72,22 @@ __all__ = [
 ]
 
 POSTMORTEM_DIR_ENV = "CK_POSTMORTEM_DIR"
+
+#: The declared event-kind vocabulary: every kind the built-in
+#: instrumentation emits.  ``tools/ckcheck`` (pass 4) fails CI on an
+#: emitted kind missing here, and ``tools/lint_obs.py`` cross-checks
+#: this tuple against the flight-recorder kind table in
+#: docs/OBSERVABILITY.md — so a new decision event is always declared
+#: AND documented.  Callers outside the package may still record ad-hoc
+#: kinds (the ring does not validate); this tuple is the contract for
+#: in-tree emitters only.
+EVENT_KINDS = (
+    "rebalance", "balance-freeze", "balance-jump",
+    "fused-engage", "fused-disengage", "fused-window",
+    "stream-choice", "stream-retune",
+    "barrier", "driver-error", "metrics-sample", "crash",
+    "debug-server", "debug-port-skipped",
+)
 
 #: Postmortem JSON schema tag — bump on incompatible changes.
 SCHEMA = "ck-postmortem-v1"
@@ -258,10 +275,14 @@ def dump_postmortem(
         path = os.path.join(path, name)
     tmp = path + ".tmp"
     with open(tmp, "w") as f:
-        # default=str: callers may put arbitrary values in their own
-        # flight events ("callers may add more"); one np.int64 must not
-        # suppress the whole black box at exactly the moment it matters
-        json.dump(doc, f, default=str)
+        # json_safe: callers may put arbitrary values in their own
+        # flight events ("callers may add more") — one np.int64 or a
+        # float('inf') must not suppress (or render unparseable) the
+        # whole black box at exactly the moment it matters.  default=str
+        # stays as the last-resort belt under allow_nan=False's braces.
+        from ..utils.jsonsafe import json_safe
+
+        json.dump(json_safe(doc), f, default=str, allow_nan=False)
     os.replace(tmp, path)
     return path
 

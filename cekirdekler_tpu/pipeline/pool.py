@@ -137,9 +137,16 @@ class ClTaskPool:
 
     def feed(self, other: "ClTaskPool") -> None:
         """Append copies of another pool's tasks (reference: feed,
-        ClPipeline.cs:3660-3670)."""
+        ClPipeline.cs:3660-3670).
+
+        ``other.snapshot()`` is taken BEFORE acquiring our lock: holding
+        it across the call nests two ClTaskPool locks, so concurrent
+        ``a.feed(b)`` / ``b.feed(a)`` acquire them in opposite orders —
+        the ABBA deadlock ckcheck's lock-order pass flags (and
+        ``a.feed(a)`` would self-deadlock on the non-reentrant lock)."""
+        tasks = other.snapshot()
         with self._lock:
-            self._tasks.extend(other.snapshot())
+            self._tasks.extend(tasks)
 
     def snapshot(self) -> list[ClTask]:
         with self._lock:
@@ -221,7 +228,12 @@ class _Consumer(threading.Thread):
                     if task.callback is not None:
                         task.callback(task)
                 except Exception as e:  # surface through the pool
-                    self.pool._errors.append(e)
+                    # under the inflight condition's lock: finish()'s
+                    # error swap must never interleave with an append
+                    # (ckcheck lockset finding — the list rode bare
+                    # GIL-atomicity before)
+                    with self.pool._inflight_lock:
+                        self.pool._errors.append(e)
                     # one bad task must not poison this chip's private
                     # cruncher for the remaining tasks (the per-compute
                     # error gate is for user-owned crunchers)
@@ -272,6 +284,10 @@ class ClDevicePool:
         self._errors: list[Exception] = []
         self._inflight = 0
         self._inflight_lock = threading.Condition()
+        # append-only under _consumers_lock; len()/iteration reads are
+        # GIL-atomic snapshots that may miss a hot-added chip for one
+        # wake — the adaptive-depth heuristic tolerates that by design
+        # ckcheck: ok append-only list; snapshot reads tolerate staleness
         self._consumers: list[_Consumer] = []
         self._consumers_lock = threading.Lock()
         for d in devices:
@@ -368,9 +384,12 @@ class ClDevicePool:
                     with self._consumers_lock:
                         if not (0 <= selected < len(self._consumers)):
                             self._done_one()
-                            self._errors.append(
-                                CekirdeklerError(f"device_select index {selected} out of range")
-                            )
+                            with self._inflight_lock:  # the errors lock
+                                self._errors.append(
+                                    CekirdeklerError(
+                                        f"device_select index {selected} "
+                                        "out of range")
+                                )
                             continue
                         self._consumers[selected].pinned.put(task)
                 else:
@@ -390,8 +409,9 @@ class ClDevicePool:
         finish, ClPipeline.cs:4433+)."""
         self._pools.join()
         self._drain()
-        if self._errors:
+        with self._inflight_lock:
             errs, self._errors = self._errors, []
+        if errs:
             raise errs[0]
 
     def dispose(self) -> None:

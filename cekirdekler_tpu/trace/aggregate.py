@@ -158,8 +158,16 @@ def gather_cluster(
         for r in rows:
             r["t0"] += skew_s
             r["t1"] += skew_s
+    from ..utils.jsonsafe import json_safe
+
+    # json_safe: a numpy scalar in a caller-supplied metrics snapshot or
+    # an inf ratio in a health report must not kill (or corrupt) the
+    # whole cluster gather — every peer decodes this payload strictly
     payload = json.dumps(
-        {"spans": rows, "metrics": metrics_snapshot, "health": health}
+        json_safe(
+            {"spans": rows, "metrics": metrics_snapshot, "health": health}
+        ),
+        allow_nan=False,
     ).encode()
     # rectangularize: exchange lengths first, pad to the max
     sizes = acc._allgather(np.asarray([len(payload)], np.int64)).reshape(-1)

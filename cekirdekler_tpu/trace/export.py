@@ -127,8 +127,15 @@ def save_chrome_trace(
     counters: dict | None = None,
 ) -> str:
     """Write the Chrome trace JSON; returns ``path`` for chaining."""
+    from ..utils.jsonsafe import json_safe
+
+    # json_safe: Perfetto's strict JSON parser rejects a bare Infinity —
+    # one inf counter sample must not make the whole trace unloadable
     with open(path, "w") as f:
-        json.dump(to_chrome_trace(spans, process_name, counters=counters), f)
+        json.dump(
+            json_safe(to_chrome_trace(spans, process_name, counters=counters)),
+            f, allow_nan=False,
+        )
     return path
 
 

@@ -101,7 +101,11 @@ def save_pytree(root: str, step: int, tree: Any) -> str:
         for i, leaf in enumerate(leaves):
             np.save(os.path.join(tmp, f"leaf_{i:05d}.npy"), np.asarray(leaf))
         with open(os.path.join(tmp, "manifest.json"), "w") as f:
-            json.dump(manifest, f)
+            from .jsonsafe import json_safe
+
+            # step may arrive as a numpy scalar from a training loop;
+            # the manifest must stay loadable by strict parsers
+            json.dump(json_safe(manifest), f, allow_nan=False)
 
     return _atomic_write(root, step, write)
 

@@ -115,6 +115,7 @@ class MarkerCounter:
         ``block_until_ready``) retires on the device — joined on a
         completion thread so in-flight depth reflects real device work,
         not host dispatch."""
+        # ckcheck: ok double-checked lazy start — re-validated under _lock
         if self._completion_thread is None:
             with self._lock:
                 if self._completion_thread is None and not self._closed:

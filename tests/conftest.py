@@ -107,6 +107,53 @@ def pytest_configure(config):
         f"virtual device rig failed to initialize: {len(jax.devices())} devices"
     )
 
+    # dynamic lock-order witness (opt-in: CK_LOCK_WITNESS=1): wrap the
+    # package's named locks, record actual acquisition orders during the
+    # run, and cross-check them against tools/ckcheck's static graph at
+    # session end (tests/_artifacts/lock_witness.json).  Disagreements
+    # are a report, not a failure — see docs/STATIC_ANALYSIS.md.
+    global _WITNESS
+    if os.environ.get("CK_LOCK_WITNESS") == "1" and _WITNESS is None:
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        if repo not in sys.path:
+            sys.path.insert(0, repo)
+        try:
+            from tools.ckcheck.witness import install
+
+            _WITNESS = install(os.path.join(repo, "cekirdekler_tpu"))
+        except Exception as e:  # noqa: BLE001 - witness must never sink a run
+            print(f"[ck-lock-witness] install failed: {e!r}", file=sys.stderr)
+
+
+_WITNESS = None
+
+
+def pytest_sessionfinish(session, exitstatus):
+    global _WITNESS
+    if _WITNESS is None:
+        return
+    try:
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        from tools.ckcheck import lock_order_edges, scan_package
+
+        pkg = scan_package(os.path.join(repo, "cekirdekler_tpu"))
+        static = set(lock_order_edges(pkg))
+        path = os.path.join(repo, "tests", "_artifacts", "lock_witness.json")
+        _WITNESS.write_report(static, path)
+        rep = _WITNESS.report(static)
+        print(
+            f"\n[ck-lock-witness] {len(rep['dynamic_edges'])} dynamic / "
+            f"{len(rep['static_edges'])} static order edges; "
+            f"{len(rep['dynamic_only'])} dynamic-only (static blind spots), "
+            f"{len(rep['static_only'])} static-only (unexercised) "
+            f"-> {path}"
+        )
+    except Exception as e:  # noqa: BLE001
+        print(f"[ck-lock-witness] report failed: {e!r}", file=sys.stderr)
+    finally:
+        _WITNESS.uninstall()
+        _WITNESS = None
+
 
 import pytest  # noqa: E402
 

@@ -1,0 +1,20 @@
+"""PLANTED BUG (never imported): ABBA lock-order cycle — ``transfer``
+acquires A then B, ``refund`` acquires B then A; interleaved across two
+threads each holds what the other wants."""
+
+import threading
+
+_lock_a = threading.Lock()
+_lock_b = threading.Lock()
+
+
+def transfer():
+    with _lock_a:
+        with _lock_b:
+            pass
+
+
+def refund():
+    with _lock_b:
+        with _lock_a:
+            pass

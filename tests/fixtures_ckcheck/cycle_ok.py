@@ -1,0 +1,19 @@
+"""Clean twin of cycle_bad: both flows acquire in ONE documented order
+(A before B, always)."""
+
+import threading
+
+_lock_a = threading.Lock()
+_lock_b = threading.Lock()
+
+
+def transfer():
+    with _lock_a:
+        with _lock_b:
+            pass
+
+
+def refund():
+    with _lock_a:
+        with _lock_b:
+            pass

@@ -1,0 +1,24 @@
+"""PLANTED BUG (never imported): the PR 6 tracer deadlock shape —
+``enable()`` holds the non-reentrant lock and calls ``snapshot()``,
+which re-takes it via ``_sync_dropped_metric``."""
+
+import threading
+
+
+class Recorder:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._dropped = 0
+
+    def _sync_dropped_metric(self):
+        with self._lock:
+            self._dropped += 1
+
+    def snapshot(self):
+        self._sync_dropped_metric()
+        return []
+
+    def enable(self):
+        with self._lock:
+            keep = self.snapshot()  # deadlock: snapshot re-takes _lock
+        return keep

@@ -1,0 +1,27 @@
+"""Clean twin of deadlock_bad: the ``_locked`` split — the lock-holding
+path calls a helper that asserts the caller holds the lock instead of
+re-acquiring it (the actual PR 6 fix shape)."""
+
+import threading
+
+
+class Recorder:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._dropped = 0
+
+    def _sync_dropped_metric(self):
+        with self._lock:
+            self._dropped += 1
+
+    def _snapshot_locked_free(self):
+        return []
+
+    def snapshot(self):
+        self._sync_dropped_metric()
+        return self._snapshot_locked_free()
+
+    def enable(self):
+        with self._lock:
+            keep = self._snapshot_locked_free()
+        return keep

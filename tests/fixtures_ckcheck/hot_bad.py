@@ -1,0 +1,11 @@
+"""PLANTED BUG (never imported): the PR 4/5/6 hot-path shape — a
+registry get-or-create inside the deferral fast path (fixed by hand at
+least four times before ckcheck)."""
+
+REGISTRY = None  # stands in for the metrics registry singleton
+
+
+class Engine:
+    def defer(self, n):
+        # get-or-create per call: dict lookup + possible registry lock
+        REGISTRY.counter("ck_deferred_total", "deferrals").inc(n)

@@ -1,0 +1,20 @@
+"""PLANTED BUG (never imported): the PR 6 RFC-8259 leak (json.dumps of
+a payload that may carry inf/nan, no guard) plus an artifact dict whose
+``headline`` key is not last."""
+
+import json
+
+
+def export(ratios):
+    return json.dumps({"ratios": ratios})  # inf -> bare `Infinity`
+
+
+def artifact(value):
+    result = {
+        "metric": "throughput",
+        "headline": {"x": value},
+        "errors": [],  # headline must be the LAST key
+    }
+    result["headline"] = {"x": value}
+    result["errors"] = []  # assigned after headline: tail contract broken
+    return result

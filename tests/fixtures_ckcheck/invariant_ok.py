@@ -1,0 +1,19 @@
+"""Clean twin of invariant_bad: allow_nan=False fails loudly instead of
+emitting invalid JSON, and ``headline`` stays the final key."""
+
+import json
+
+
+def export(ratios):
+    return json.dumps({"ratios": ratios}, allow_nan=False)
+
+
+def artifact(value):
+    result = {
+        "metric": "throughput",
+        "errors": [],
+        "headline": {"x": value},
+    }
+    result["errors"] = []
+    result["headline"] = {"x": value}
+    return result

@@ -1,0 +1,21 @@
+"""PLANTED BUG (never imported): the seed-era enqueue/rebalance
+lost-update shape — ``pending`` is incremented under the lock on the
+worker thread, but the rebalance path does a bare read-modify-write,
+so a concurrent increment can be lost."""
+
+import threading
+
+
+class Scheduler:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.pending = 0
+        self._thread = threading.Thread(target=self._worker, daemon=True)
+
+    def _worker(self):
+        while True:
+            with self._lock:
+                self.pending += 1
+
+    def rebalance(self):
+        self.pending = self.pending // 2  # unlocked RMW: lost update

@@ -1,0 +1,19 @@
+"""Clean twin of race_bad: every touch of ``pending`` holds the lock."""
+
+import threading
+
+
+class Scheduler:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.pending = 0
+        self._thread = threading.Thread(target=self._worker, daemon=True)
+
+    def _worker(self):
+        while True:
+            with self._lock:
+                self.pending += 1
+
+    def rebalance(self):
+        with self._lock:
+            self.pending = self.pending // 2

@@ -1,0 +1,39 @@
+"""Shared RFC-8259 sanitizer for the standalone tools.
+
+The in-package version is ``cekirdekler_tpu.utils.jsonsafe`` — the
+tools cannot import it (they must run on rigs where jax, and therefore
+the package, is broken), so they load THIS file by path via their
+``_json_safe`` shim.  Same rules: non-finite floats → ``None``, numpy
+scalars → native, ndarrays → sanitized lists, keys → strings, unknown
+objects → ``str``.
+"""
+
+from __future__ import annotations
+
+import math
+
+__all__ = ["json_safe"]
+
+
+def json_safe(o):
+    if isinstance(o, bool) or o is None or isinstance(o, (str, int)):
+        return o
+    if isinstance(o, float):
+        return o if math.isfinite(o) else None
+    if isinstance(o, dict):
+        return {str(k): json_safe(v) for k, v in o.items()}
+    if isinstance(o, (list, tuple, set, frozenset)):
+        return [json_safe(v) for v in o]
+    item = getattr(o, "item", None)
+    if item is not None and getattr(o, "shape", None) in ((), None):
+        try:
+            return json_safe(item())
+        except Exception:  # noqa: BLE001 - fall through to str()
+            pass
+    tolist = getattr(o, "tolist", None)
+    if tolist is not None:
+        try:
+            return json_safe(tolist())
+        except Exception:  # noqa: BLE001 - fall through to str()
+            pass
+    return str(o)

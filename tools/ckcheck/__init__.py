@@ -1,0 +1,27 @@
+"""ckcheck — repo-wide concurrency & hot-path static analyzer.
+
+Four pure-``ast`` passes over ``cekirdekler_tpu/`` (lock-order graph,
+Eraser-style lockset race detection, hot-path discipline, invariant
+lints) against a ratcheted baseline.  See docs/STATIC_ANALYSIS.md and
+``python -m tools.ckcheck --help``.
+"""
+
+from .baseline import load_baseline, ratchet, save_baseline
+from .cli import analyze_repo, main, repo_config
+from .model import Finding, Package, scan_package
+from .passes import AnalyzerConfig, lock_order_edges, run_passes
+
+__all__ = [
+    "AnalyzerConfig",
+    "Finding",
+    "Package",
+    "analyze_repo",
+    "lock_order_edges",
+    "load_baseline",
+    "main",
+    "ratchet",
+    "repo_config",
+    "run_passes",
+    "save_baseline",
+    "scan_package",
+]

@@ -1,0 +1,61 @@
+"""The ratchet baseline: findings may only go away.
+
+``baseline.json`` is a checked-in inventory of grandfathered findings
+(fingerprint + human-readable location).  The contract, enforced by
+:func:`ratchet`:
+
+- a finding NOT in the baseline **fails** the run (new debt is refused);
+- a baseline entry with no matching finding ALSO fails ("stale
+  baseline") — fixing a finding must shrink the checked-in file in the
+  same commit, so the count is monotonically decreasing and reviewable
+  in diffs;
+- ``--update-baseline`` rewrites the file from the current findings,
+  but **refuses to grow** it unless ``--allow-grow`` is also passed —
+  adding debt is a deliberate, flagged act, never a reflex.
+
+Fingerprints exclude line numbers (see :class:`~.model.Finding`), so
+edits above a grandfathered finding do not churn the baseline; the
+stored line is refreshed on every ``--update-baseline`` purely for
+human navigation.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+__all__ = ["load_baseline", "save_baseline", "ratchet"]
+
+SCHEMA = "ckcheck-baseline-v1"
+
+
+def load_baseline(path: str) -> dict:
+    """fingerprint → stored row.  A missing file is an empty baseline."""
+    if not os.path.exists(path):
+        return {}
+    with open(path) as f:
+        doc = json.load(f)
+    return {row["fingerprint"]: row for row in doc.get("findings", ())}
+
+
+def save_baseline(path: str, findings) -> None:
+    rows = sorted(
+        (f.to_row() for f in findings), key=lambda r: r["fingerprint"])
+    doc = {"schema": SCHEMA, "findings": rows}
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=True, allow_nan=False)
+        f.write("\n")
+    os.replace(tmp, path)
+
+
+def ratchet(findings, baseline: dict):
+    """``(new, grandfathered, stale)`` — findings not in the baseline,
+    findings covered by it, and baseline rows no finding matches."""
+    current = {f.fingerprint: f for f in findings}
+    new = [f for fp, f in current.items() if fp not in baseline]
+    grand = [f for fp, f in current.items() if fp in baseline]
+    stale = [row for fp, row in baseline.items() if fp not in current]
+    new.sort(key=lambda f: (f.path, f.line))
+    stale.sort(key=lambda r: (r.get("path", ""), r.get("line", 0)))
+    return new, grand, stale

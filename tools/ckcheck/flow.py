@@ -1,0 +1,110 @@
+"""Interprocedural held-set propagation shared by the lock-order,
+lockset, and hot-path passes.
+
+Entry-context model: a function's **entry contexts** are the lock sets
+that may be held when it is entered.
+
+- Functions with no in-package callers (thread bodies, public API
+  surface, closures submitted to driver queues) are entries: they get
+  the empty context.
+- Everything else inherits contexts from its call sites only — a
+  helper called exclusively under its class's mutex is analyzed as
+  holding that mutex.  This includes PUBLIC methods with in-package
+  callers (``Worker.upload`` is called only under the worker phase
+  lock): the analyzer models the in-tree discipline, and a hypothetical
+  external unlocked caller is out of scope by design — the dynamic
+  witness (CK_LOCK_WITNESS) covers what the model cannot see.
+- Call sites inside lifecycle methods (``__init__``/``dispose``/...)
+  do not propagate: construction and teardown are single-threaded by
+  contract, and seeding their empty held-sets into shared helpers
+  would erase the guard evidence of the steady-state callers.
+
+The propagation is a worklist fixpoint over ``caller_entry ∪
+held_at_call_site``; context sets are capped (collapse to their
+intersection past :data:`MAX_CONTEXTS`) so pathological fan-in cannot
+blow up, at the cost of precision, never soundness of the
+under-approximation.
+"""
+
+from __future__ import annotations
+
+from .model import LIFECYCLE_METHODS, Package
+
+__all__ = ["entry_contexts", "reachable_from"]
+
+MAX_CONTEXTS = 12
+
+
+def _is_lifecycle(qualname: str) -> bool:
+    return qualname.rsplit(".", 1)[-1] in LIFECYCLE_METHODS
+
+
+def _has_callers(pkg: Package) -> set:
+    called = set()
+    for q, fi in pkg.functions.items():
+        if _is_lifecycle(q):
+            continue
+        for cs in fi.call_sites:
+            called.update(cs.targets)
+    return called
+
+
+def entry_contexts(pkg: Package) -> dict[str, frozenset]:
+    """qualname → set of frozenset lock-id entry contexts."""
+    ctxs: dict[str, set] = {q: set() for q in pkg.functions}
+    called = _has_callers(pkg)
+    for q, fi in pkg.functions.items():
+        if q not in called or fi.is_nested or _is_lifecycle(q):
+            ctxs[q].add(frozenset())
+
+    work = list(pkg.functions)
+    rounds = 0
+    while work and rounds < 50:
+        rounds += 1
+        next_work: list[str] = []
+        for q in work:
+            if _is_lifecycle(q):
+                continue  # lifecycle call sites do not propagate
+            fi = pkg.functions[q]
+            my_ctxs = ctxs[q]
+            if not my_ctxs:
+                # not yet reached from any entry — propagating a default
+                # empty context here would poison callees with a held-set
+                # the real callers never produce; the worklist revisits
+                # this function once its own contexts arrive
+                continue
+            for cs in fi.call_sites:
+                for tgt in cs.targets:
+                    if tgt not in ctxs:
+                        continue
+                    for e in my_ctxs:
+                        new = frozenset(e | set(cs.held))
+                        if new not in ctxs[tgt]:
+                            ctxs[tgt].add(new)
+                            next_work.append(tgt)
+            if len(ctxs[q]) > MAX_CONTEXTS:
+                merged = frozenset.intersection(*ctxs[q])
+                ctxs[q] = {merged}
+        work = next_work
+    return ctxs
+
+
+def reachable_from(pkg: Package, roots, respect_cold: bool = True) -> set:
+    """Call-graph closure of ``roots`` (qualnames).  Functions annotated
+    ``# ckcheck: cold`` stop the walk — they are batch/window-granularity
+    boundaries the hot-path discipline does not cross."""
+    seen: set = set()
+    stack = [r for r in roots if r in pkg.functions]
+    while stack:
+        q = stack.pop()
+        if q in seen:
+            continue
+        fi = pkg.functions[q]
+        if respect_cold and fi.cold and q not in roots:
+            continue
+        seen.add(q)
+        for cs in fi.call_sites:
+            for tgt in cs.targets:
+                if tgt in pkg.functions and tgt not in seen:
+                    stack.append(tgt)
+    return seen

@@ -1,0 +1,459 @@
+"""The four ckcheck passes over a scanned :class:`~.model.Package`.
+
+1. **lock-order** — build the acquisition-order graph (edge ``A → B``
+   when ``B`` is acquired while ``A`` is held, interprocedurally), flag
+   cycles, and flag re-acquisition of a non-reentrant lock along one
+   flow (the PR 6 tracer deadlock shape: ``snapshot()`` called under
+   the tracer lock which ``_sync_dropped_metric`` also takes).
+2. **lockset** — Eraser-style: for classes in thread-spawning modules,
+   every attribute touched both under and outside any common lock is a
+   candidate race (the seed-era enqueue/rebalance lost-update shape).
+3. **hotpath** — functions reachable from the declared hot roots must
+   not call registry get-or-create, must not take locks outside the
+   allowlist, and must not compute telemetry arguments outside an
+   ``.enabled`` guard (the PR 4/5/6 cached-handles review discipline).
+4. **invariant** — artifact writers keep ``headline`` last; emitted
+   span/flight kinds are declared in their vocabulary tuples;
+   ``json.dumps`` on export paths is Infinity/NaN-safe.
+
+Each pass returns ``list[Finding]``; suppression comments
+(``# ckcheck: ok`` / ``guarded-by`` / ``cold``) are honored here.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+from .flow import entry_contexts, reachable_from
+from .model import Finding, LIFECYCLE_METHODS, Package
+
+__all__ = ["AnalyzerConfig", "run_passes", "lock_order_edges"]
+
+
+@dataclass
+class AnalyzerConfig:
+    """Per-repo knobs.  The defaults describe cekirdekler_tpu; fixture
+    tests construct their own."""
+
+    # pass 3 roots: the declared hot set (qualnames relative to the
+    # scanned package root)
+    hot_roots: tuple = ()
+    # locks the hot path MAY take (lock_ids)
+    hot_lock_allow: tuple = ()
+    # pass 4 vocabularies: (module, tuple-variable) declaring the
+    # legal span/flight kinds; None disables the corresponding rule
+    span_vocab: tuple | None = None     # ("trace.spans", "SPAN_KINDS")
+    event_vocab: tuple | None = None    # ("obs.flight", "EVENT_KINDS")
+    # passes to run (all by default)
+    passes: tuple = ("lock-order", "lockset", "hotpath", "invariant")
+
+
+# ---------------------------------------------------------------------------
+# pass 1: lock-order graph
+# ---------------------------------------------------------------------------
+
+def lock_order_edges(pkg: Package) -> dict:
+    """``{(held_id, acquired_id): (path, line)}`` — first evidence site
+    per ordered pair, interprocedural (entry contexts included)."""
+    ctxs = entry_contexts(pkg)
+    edges: dict = {}
+    for q, fi in pkg.functions.items():
+        entry = ctxs.get(q) or {frozenset()}
+        for site in fi.acq_sites:
+            for e in entry:
+                for h in set(e) | set(site.held):
+                    if h == site.lock.lock_id:
+                        continue
+                    key = (h, site.lock.lock_id)
+                    edges.setdefault(key, (fi.path, site.line))
+    return edges
+
+
+def _cycles(edges: dict) -> list:
+    """SCCs with more than one node in the order graph (Tarjan)."""
+    graph: dict = {}
+    for (a, b) in edges:
+        graph.setdefault(a, set()).add(b)
+        graph.setdefault(b, set())
+    index: dict = {}
+    low: dict = {}
+    on_stack: set = set()
+    stack: list = []
+    sccs: list = []
+    counter = [0]
+
+    def strongconnect(v):
+        # iterative Tarjan (the package's call depth is small but the
+        # analyzer must not rely on Python recursion limits)
+        work = [(v, iter(sorted(graph[v])))]
+        index[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        on_stack.add(v)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    on_stack.add(w)
+                    work.append((w, iter(sorted(graph[w]))))
+                    advanced = True
+                    break
+                elif w in on_stack:
+                    low[node] = min(low[node], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                scc = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    scc.append(w)
+                    if w == node:
+                        break
+                if len(scc) > 1:
+                    sccs.append(sorted(scc))
+
+    for v in sorted(graph):
+        if v not in index:
+            strongconnect(v)
+    return sccs
+
+
+def pass_lock_order(pkg: Package) -> list:
+    findings: list = []
+    ctxs = entry_contexts(pkg)
+    edges = lock_order_edges(pkg)
+    for scc in _cycles(edges):
+        ev_path, ev_line = None, 0
+        for (a, b), (p, ln) in sorted(edges.items()):
+            if a in scc and b in scc:
+                ev_path, ev_line = p, ln
+                break
+        findings.append(Finding(
+            pass_id="lock-order", rule="order-cycle",
+            path=ev_path or "?", line=ev_line,
+            subject="<->".join(scc),
+            message=(
+                "lock-order cycle: " + " -> ".join(scc + [scc[0]]) +
+                " — two flows acquire these locks in opposite order "
+                "(deadlock when they interleave)"),
+        ))
+    for q, fi in pkg.functions.items():
+        entry = ctxs.get(q) or {frozenset()}
+        mod = pkg.modules.get(fi.module)
+        for site in fi.acq_sites:
+            if site.lock.reentrant or site.conditional:
+                continue
+            if site.receiver not in ("self", "singleton"):
+                continue  # different instances of one lock class are fine
+            held_ids = set(site.held)
+            entry_hit = any(site.lock.lock_id in e for e in entry)
+            if site.lock.lock_id in held_ids or entry_hit:
+                if mod and mod.suppressed(site.line):
+                    continue
+                how = ("already held on this flow" if site.lock.lock_id
+                       in held_ids else "held by a caller on some flow")
+                findings.append(Finding(
+                    pass_id="lock-order", rule="reacquire",
+                    path=fi.path, line=site.line,
+                    subject=f"{q}:{site.lock.lock_id}",
+                    message=(
+                        f"{q} re-acquires non-reentrant "
+                        f"{site.lock.lock_id} ({how}) — self-deadlock"),
+                ))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# pass 2: lockset race detection
+# ---------------------------------------------------------------------------
+
+@dataclass
+class _AttrSites:
+    writes: list = field(default_factory=list)   # (fi, access, locksets)
+    reads: list = field(default_factory=list)
+
+
+def _site_lockset(entry: set, held: tuple) -> frozenset:
+    """Locks guaranteed held at a site = locks held on EVERY path:
+    intersection of (entry ∪ local) over entry contexts."""
+    combos = [frozenset(e | set(held)) for e in (entry or {frozenset()})]
+    out = combos[0]
+    for c in combos[1:]:
+        out &= c
+    return out
+
+
+def pass_lockset(pkg: Package) -> list:
+    findings: list = []
+    ctxs = entry_contexts(pkg)
+    per_attr: dict = {}
+    for q, fi in pkg.functions.items():
+        method = q.rsplit(".", 1)[-1]
+        if method in LIFECYCLE_METHODS:
+            continue
+        mod = pkg.modules.get(fi.module)
+        entry = ctxs.get(q) or {frozenset()}
+        for acc in fi.attr_accesses:
+            owner = acc.owner
+            if owner is None:
+                continue
+            owner_mod = pkg.classes[owner].module
+            if not pkg.modules[owner_mod].spawns_threads:
+                continue
+            if acc.attr.startswith("__"):
+                continue
+            sup = mod.suppressed(acc.line) if mod else None
+            if sup and sup[0] == "ok":
+                continue
+            lockset = _site_lockset(entry, acc.held)
+            if sup and sup[0] == "guarded-by":
+                # protocol-guarded: trust the annotation, treat the
+                # named lock as held
+                name = sup[1].split()[0] if sup[1] else ""
+                cands = pkg.locks_named(name.rsplit(".", 1)[-1]) if name else []
+                lockset = lockset | {c.lock_id for c in cands[:1]} if cands \
+                    else lockset | {f"<protocol:{name or 'declared'}>"}
+            rec = per_attr.setdefault((owner, acc.attr), _AttrSites())
+            (rec.writes if acc.is_write else rec.reads).append(
+                (fi, acc, lockset))
+
+    for (owner, attr), rec in sorted(per_attr.items()):
+        if not rec.writes:
+            continue
+        ci = pkg.classes[owner]
+        owner_module = pkg.modules.get(ci.module)
+        init_line = ci.attr_init_lines.get(attr)
+        if owner_module and init_line and \
+                owner_module.suppressed(init_line, kinds=("ok",)):
+            continue  # attribute-level suppression at its __init__ line
+        # the guard set comes from WRITE sites only: a config flag read
+        # under some other lock by coincidence must not make that lock
+        # look like the attribute's guard
+        guards = frozenset().union(*(s[2] for s in rec.writes)) \
+            if rec.writes else frozenset()
+        write_guards = [s[2] for s in rec.writes if s[2]]
+        if not write_guards:
+            continue  # never write-locked: thread-confined or by design
+        sites = rec.writes + rec.reads
+        common = sites[0][2]
+        for s in sites[1:]:
+            common = common & s[2]
+        if common:
+            continue  # a consistent guard exists
+        guards = frozenset().union(*write_guards)
+        unlocked = [s for s in sites if not (s[2] & guards)]
+        if not unlocked:
+            continue
+        unlocked_writes = [s for s in unlocked if s[1].is_write]
+        rule = "mixed-guard" if unlocked_writes else "unguarded-read"
+        anchor = (unlocked_writes or unlocked)[0]
+        guard_names = sorted(guards)
+        un_lines = sorted({f"{s[0].path}:{s[1].line}" for s in unlocked})
+        what = ("written" if unlocked_writes else "read")
+        consequence = (
+            "lost-update / torn-state candidate" if unlocked_writes else
+            "stale/torn read candidate")
+        findings.append(Finding(
+            pass_id="lockset", rule=rule,
+            path=anchor[0].path, line=anchor[1].line,
+            subject=f"{owner}.{attr}",
+            message=(
+                f"{owner}.{attr} is written under {guard_names} but "
+                f"{what} with no common lock at "
+                f"{', '.join(un_lines[:6])}"
+                f"{' …' if len(un_lines) > 6 else ''} — {consequence} "
+                "(annotate `# ckcheck: ok <why>` at the site or at the "
+                "attribute's __init__ line if lock-free access is by "
+                "design)"),
+        ))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# pass 3: hot-path discipline
+# ---------------------------------------------------------------------------
+
+def pass_hotpath(pkg: Package, cfg: AnalyzerConfig) -> list:
+    findings: list = []
+    if not cfg.hot_roots:
+        return findings
+    hot = reachable_from(pkg, set(cfg.hot_roots))
+    allow = set(cfg.hot_lock_allow)
+    for q in sorted(hot):
+        fi = pkg.functions[q]
+        mod = pkg.modules.get(fi.module)
+        for rc in fi.registry_calls:
+            if mod and mod.suppressed(rc.line):
+                continue
+            findings.append(Finding(
+                pass_id="hotpath", rule="get-or-create",
+                path=fi.path, line=rc.line,
+                subject=f"{q}:REGISTRY.{rc.method}:{rc.name or '?'}",
+                message=(
+                    f"{q} (hot path) calls REGISTRY.{rc.method}"
+                    f"({rc.name!r}) — get-or-create pays a dict lookup + "
+                    "possible registry lock per call; cache the handle "
+                    "at construction (the PR 4 discipline)"),
+            ))
+        for site in fi.acq_sites:
+            if site.lock.lock_id in allow:
+                continue
+            if mod and mod.suppressed(site.line):
+                continue
+            findings.append(Finding(
+                pass_id="hotpath", rule="hot-lock",
+                path=fi.path, line=site.line,
+                subject=f"{q}:{site.lock.lock_id}",
+                message=(
+                    f"{q} (hot path) acquires {site.lock.lock_id}, which "
+                    "is not in the hot-path lock allowlist"),
+            ))
+        for tc in fi.telemetry_calls:
+            if not tc.computed_args or tc.enabled_guarded:
+                continue
+            if mod and mod.suppressed(tc.line):
+                continue
+            findings.append(Finding(
+                pass_id="hotpath", rule="telemetry-alloc",
+                path=fi.path, line=tc.line,
+                subject=f"{q}:{tc.api}:{tc.kind or '?'}",
+                message=(
+                    f"{q} (hot path) computes arguments for a telemetry "
+                    f"call ({tc.method} {tc.kind!r}) outside an "
+                    "`.enabled` guard — the f-string/concat/call "
+                    "allocates even when recording is off"),
+            ))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# pass 4: invariant lints
+# ---------------------------------------------------------------------------
+
+def _load_vocab(pkg: Package, spec) -> set | None:
+    if spec is None:
+        return None
+    modname, varname = spec
+    mod = pkg.modules.get(modname)
+    if mod is None:
+        return None
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == varname:
+                    try:
+                        return set(ast.literal_eval(node.value))
+                    except Exception:  # noqa: BLE001 - computed vocab
+                        return None
+    return None
+
+
+def pass_invariant(pkg: Package, cfg: AnalyzerConfig) -> list:
+    findings: list = []
+    span_kinds = _load_vocab(pkg, cfg.span_vocab)
+    event_kinds = _load_vocab(pkg, cfg.event_vocab)
+    for q, fi in sorted(pkg.functions.items()):
+        mod = pkg.modules.get(fi.module)
+
+        for line in fi.dict_literal_headline:
+            if mod and mod.suppressed(line):
+                continue
+            findings.append(Finding(
+                pass_id="invariant", rule="headline-last",
+                path=fi.path, line=line, subject=f"{q}:dict",
+                message=(
+                    f"{q} builds an artifact dict whose 'headline' key "
+                    "is not last — the driver's 2000-char tail recovery "
+                    "depends on headline being the final key"),
+            ))
+        # sequenced writes: result["headline"] = ... then result[x] = ...
+        by_base: dict = {}
+        for sa in fi.subscript_assigns:
+            by_base.setdefault(sa.base, []).append(sa)
+        for base, sas in by_base.items():
+            hl = [s for s in sas if s.key == "headline"]
+            if not hl:
+                continue
+            last_hl = max(s.stmt_index for s in hl)
+            after = [s for s in sas
+                     if s.stmt_index > last_hl and s.key != "headline"]
+            for s in after:
+                if mod and mod.suppressed(s.line):
+                    continue
+                findings.append(Finding(
+                    pass_id="invariant", rule="headline-last",
+                    path=fi.path, line=s.line,
+                    subject=f"{q}:{base}[{s.key!r}]",
+                    message=(
+                        f"{q} assigns {base}[{s.key!r}] after "
+                        f"{base}['headline'] — headline must stay the "
+                        "final key of the artifact"),
+                ))
+
+        for tc in fi.telemetry_calls:
+            vocab = span_kinds if tc.api == "span" else event_kinds
+            if vocab is None or tc.kind is None or tc.kind in vocab:
+                continue
+            if mod and mod.suppressed(tc.line):
+                continue
+            what = ("SPAN_KINDS" if tc.api == "span" else "EVENT_KINDS")
+            findings.append(Finding(
+                pass_id="invariant", rule="undeclared-kind",
+                path=fi.path, line=tc.line,
+                subject=f"{tc.api}:{tc.kind}",
+                message=(
+                    f"{q} emits {tc.api} kind {tc.kind!r} which is not "
+                    f"declared in {what} — declare it (and document it: "
+                    "lint_obs checks the doc side)"),
+            ))
+
+        for jc in fi.json_calls:
+            if jc.has_allow_nan_false or jc.sanitized:
+                continue
+            if mod and mod.suppressed(jc.line):
+                continue
+            findings.append(Finding(
+                pass_id="invariant", rule="json-unsafe",
+                path=fi.path, line=jc.line, subject=f"{q}:json@{jc.line}",
+                message=(
+                    f"{q} calls json.dumps/dump without allow_nan=False "
+                    "or json_safe(...) — a float('inf')/nan anywhere in "
+                    "the payload serializes as bare `Infinity`/`NaN` "
+                    "(RFC-8259-invalid; the PR 6 /healthz bug class), "
+                    "and numpy scalars raise TypeError mid-export"),
+            ))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+
+def run_passes(pkg: Package, cfg: AnalyzerConfig) -> list:
+    findings: list = []
+    # a file that failed to parse is a finding, not a silent skip
+    for mod in pkg.modules.values():
+        err = getattr(mod.tree, "_ckcheck_syntax_error", None)
+        if err:
+            findings.append(Finding(
+                pass_id="invariant", rule="syntax-error", path=mod.path,
+                line=0, subject=mod.modname, message=f"unparseable: {err}"))
+    if "lock-order" in cfg.passes:
+        findings.extend(pass_lock_order(pkg))
+    if "lockset" in cfg.passes:
+        findings.extend(pass_lockset(pkg))
+    if "hotpath" in cfg.passes:
+        findings.extend(pass_hotpath(pkg, cfg))
+    if "invariant" in cfg.passes:
+        findings.extend(pass_invariant(pkg, cfg))
+    order = {"lock-order": 0, "lockset": 1, "hotpath": 2, "invariant": 3}
+    findings.sort(key=lambda f: (order.get(f.pass_id, 9), f.path, f.line))
+    return findings

@@ -1,0 +1,193 @@
+"""Dynamic lock-order witness: cross-check ckcheck's STATIC acquisition
+graph against the orders the test suite ACTUALLY exercises.
+
+Opt-in via ``CK_LOCK_WITNESS=1`` (tests/conftest.py installs it before
+the suite runs).  :func:`install` wraps ``threading.Lock`` / ``RLock``
+/ ``Condition`` with factories that tag each lock created from a line
+the static inventory knows (file+line → ``lock_id``); named locks push
+and pop a thread-local held stack on acquire/release, and every
+(held → acquired) pair of named locks is recorded as a dynamic edge.
+Locks created anywhere else (pytest internals, jax, stdlib) pass
+through unwrapped — zero overhead outside the package.
+
+:func:`report` then compares:
+
+- **static-only** edges — orders the analyzer believes exist but the
+  suite never exercised (dead order info, or coverage gaps worth a
+  test);
+- **dynamic-only** edges — orders the suite EXECUTED that the static
+  graph missed (analyzer blind spots: unresolved receivers, getattr
+  indirection).  These are the edges that keep the static pass honest.
+
+Disagreements are a REPORT artifact, not a failure: the witness bounds
+the static analyzer's blind spots, it does not gate CI (a run's edge
+set depends on which tests ran).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+
+__all__ = ["install", "Witness"]
+
+
+class _Local(threading.local):
+    def __init__(self):
+        self.held = []
+
+
+class Witness:
+    def __init__(self, site_to_lock: dict):
+        self._site_to_lock = site_to_lock   # (abspath, line) -> lock_id
+        self._edges: set = set()            # (held_id, acquired_id)
+        self._seen_locks: set = set()
+        self._tl = _Local()
+        self._mu = threading.Lock()
+        self._orig = None
+
+    # -- recording -----------------------------------------------------------
+    def _on_acquire(self, lock_id: str) -> None:
+        held = self._tl.held
+        if held:
+            new = {(h, lock_id) for h in held
+                   if h != lock_id and (h, lock_id) not in self._edges}
+            if new:
+                with self._mu:
+                    self._edges |= new
+        held.append(lock_id)
+        self._seen_locks.add(lock_id)
+
+    def _on_release(self, lock_id: str) -> None:
+        held = self._tl.held
+        # remove the most recent matching entry (non-LIFO releases exist)
+        for i in range(len(held) - 1, -1, -1):
+            if held[i] == lock_id:
+                del held[i]
+                break
+
+    # -- results -------------------------------------------------------------
+    def dynamic_edges(self) -> set:
+        with self._mu:
+            return set(self._edges)
+
+    def report(self, static_edges) -> dict:
+        """Compare against ``{(held, acquired), ...}`` from
+        :func:`tools.ckcheck.lock_order_edges`."""
+        dyn = self.dynamic_edges()
+        stat = set(static_edges)
+        return {
+            "dynamic_edges": sorted(map(list, dyn)),
+            "static_edges": sorted(map(list, stat)),
+            "static_only": sorted(map(list, stat - dyn)),
+            "dynamic_only": sorted(map(list, dyn - stat)),
+            "locks_witnessed": sorted(self._seen_locks),
+        }
+
+    def write_report(self, static_edges, path: str) -> str:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        doc = self.report(static_edges)
+        with open(path, "w") as f:
+            json.dump(doc, f, indent=1, sort_keys=True, allow_nan=False)
+            f.write("\n")
+        return path
+
+    # -- teardown ------------------------------------------------------------
+    def uninstall(self) -> None:
+        if self._orig is not None:
+            threading.Lock, threading.RLock, threading.Condition = self._orig
+            self._orig = None
+
+
+class _NamedLock:
+    """Proxy wrapping a real lock; records order edges for its
+    inventory-known creation site.  Supports the subset of the lock API
+    the package uses (``with``, acquire/release, Condition wait/notify
+    when wrapping a Condition)."""
+
+    def __init__(self, real, lock_id: str, witness: Witness):
+        self._real = real
+        self._lock_id = lock_id
+        self._witness = witness
+
+    def acquire(self, *a, **kw):
+        got = self._real.acquire(*a, **kw)
+        if got:
+            self._witness._on_acquire(self._lock_id)
+        return got
+
+    def release(self):
+        self._witness._on_release(self._lock_id)
+        return self._real.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def locked(self):
+        return self._real.locked()
+
+    # Condition API passthrough (wait releases/re-takes the REAL lock;
+    # the held-stack intentionally keeps the entry — the waiting thread
+    # still "owns" the order slot when it resumes)
+    def wait(self, timeout=None):
+        return self._real.wait(timeout)
+
+    def wait_for(self, predicate, timeout=None):
+        return self._real.wait_for(predicate, timeout)
+
+    def notify(self, n=1):
+        return self._real.notify(n)
+
+    def notify_all(self):
+        return self._real.notify_all()
+
+    def __getattr__(self, name):
+        return getattr(self._real, name)
+
+
+def _creation_site(depth: int = 2):
+    import sys
+
+    frame = sys._getframe(depth)
+    return (os.path.abspath(frame.f_code.co_filename), frame.f_lineno)
+
+
+def install(package_root: str) -> Witness:
+    """Patch the threading lock factories; locks created at inventory-
+    known sites under ``package_root`` come back wrapped.  Returns the
+    witness (keep it; call ``uninstall()`` when done)."""
+    from .model import scan_package
+
+    pkg = scan_package(package_root)
+    site_to_lock = {
+        (os.path.abspath(os.path.join(os.path.dirname(package_root),
+                                      lock.path)), lock.line): lock.lock_id
+        for lock in pkg.locks.values()
+    }
+    w = Witness(site_to_lock)
+    orig_lock, orig_rlock, orig_cond = (
+        threading.Lock, threading.RLock, threading.Condition)
+    w._orig = (orig_lock, orig_rlock, orig_cond)
+
+    def make(factory):
+        def wrapped(*a, **kw):
+            real = factory(*a, **kw)
+            try:
+                lock_id = w._site_to_lock.get(_creation_site())
+            except Exception:  # noqa: BLE001 - never break lock creation
+                lock_id = None
+            if lock_id is None:
+                return real
+            return _NamedLock(real, lock_id, w)
+        return wrapped
+
+    threading.Lock = make(orig_lock)
+    threading.RLock = make(orig_rlock)
+    threading.Condition = make(orig_cond)
+    return w

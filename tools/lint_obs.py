@@ -6,14 +6,22 @@ Two inventories, compared both ways, no imports (pure source scanning —
 the lint can run anywhere, including rigs where jax is broken):
 
 - **Metric names.**  Every ``ck_*`` series registered in
-  ``cekirdekler_tpu/`` (literal first arguments of
-  ``REGISTRY.counter/gauge/histogram`` calls) must appear in
-  docs/OBSERVABILITY.md, and every ``ck_*`` token the doc mentions must
-  be registered somewhere — a doc describing a metric that no longer
-  exists is worse than no doc.
+  ``cekirdekler_tpu/`` must appear in docs/OBSERVABILITY.md, and every
+  ``ck_*`` token the doc mentions must be registered somewhere — a doc
+  describing a metric that no longer exists is worse than no doc.  The
+  inventory is the union of a regex over
+  ``REGISTRY.counter/gauge/histogram`` literals and an ``ast`` walk
+  over EVERY ``.counter/.gauge/.histogram`` call with a ``ck_*``
+  literal first argument — the ast side sees through formatting and
+  cached-handle helper indirection the regex cannot (PR 7: handle
+  factories made the regex-only inventory incomplete).
 - **Span kinds.**  The ``SPAN_KINDS`` tuple in ``trace/spans.py``
   (parsed with ``ast``, not imported) must match the kind table in the
   doc's tracer section exactly, both directions.
+- **Flight event kinds.**  The ``EVENT_KINDS`` tuple in
+  ``obs/flight.py`` must match the kind table in the doc's flight-
+  recorder section exactly, both directions (PR 7; emitted-vs-declared
+  is ``tools/ckcheck``'s invariant pass).
 
 Exit 0 clean; exit 1 with the diff printed.  Runs as a tier-1 test
 (``tests/test_lint_obs.py``), so a PR adding a ``ck_`` series without
@@ -31,6 +39,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 DOC = os.path.join(REPO, "docs", "OBSERVABILITY.md")
 PKG = os.path.join(REPO, "cekirdekler_tpu")
 SPANS_PY = os.path.join(PKG, "trace", "spans.py")
+FLIGHT_PY = os.path.join(PKG, "obs", "flight.py")
 
 #: Registration call pattern: REGISTRY.counter("ck_x", ...) — the first
 #: argument is always a string literal in this codebase (the lint EXISTS
@@ -47,6 +56,30 @@ _DOC_NAME_RE = re.compile(r"\bck_[a-z0-9_]+\b")
 _EXPOSITION_SUFFIXES = ("_bucket", "_sum", "_count")
 
 
+def _ast_metric_names(source: str) -> set[str]:
+    """``ck_*`` literal first args of ANY ``.counter/.gauge/.histogram``
+    call — receiver-agnostic on purpose: cached-handle helpers
+    (``self._reg.gauge(...)``, a factory parameter) register series the
+    ``REGISTRY.``-anchored regex never sees."""
+    out: set[str] = set()
+    try:
+        tree = ast.parse(source)
+    except SyntaxError:
+        return out
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in ("counter", "gauge", "histogram")
+            and node.args
+            and isinstance(node.args[0], ast.Constant)
+            and isinstance(node.args[0].value, str)
+            and node.args[0].value.startswith("ck_")
+        ):
+            out.add(node.args[0].value)
+    return out
+
+
 def code_metric_names() -> set[str]:
     names: set[str] = set()
     for root, _dirs, files in os.walk(PKG):
@@ -54,7 +87,9 @@ def code_metric_names() -> set[str]:
             if not fn.endswith(".py"):
                 continue
             with open(os.path.join(root, fn)) as f:
-                names.update(_REG_RE.findall(f.read()))
+                source = f.read()
+            names.update(_REG_RE.findall(source))
+            names.update(_ast_metric_names(source))
     return names
 
 
@@ -77,32 +112,52 @@ def doc_metric_names(doc_text: str) -> set[str]:
     return out
 
 
-def code_span_kinds() -> set[str]:
-    """``SPAN_KINDS`` parsed out of trace/spans.py without importing."""
-    tree = ast.parse(open(SPANS_PY).read())
+def _tuple_var(path: str, varname: str) -> set[str]:
+    tree = ast.parse(open(path).read())
     for node in ast.walk(tree):
         if isinstance(node, ast.Assign):
             for t in node.targets:
-                if isinstance(t, ast.Name) and t.id == "SPAN_KINDS":
+                if isinstance(t, ast.Name) and t.id == varname:
                     return set(ast.literal_eval(node.value))
-    raise AssertionError("SPAN_KINDS tuple not found in trace/spans.py")
+    raise AssertionError(f"{varname} tuple not found in {path}")
 
 
-def doc_span_kinds(doc_text: str) -> set[str]:
-    """First-cell backticked tokens of the kind table in the tracer
-    section (rows look like ``| `enqueue`        | cores ... |``)."""
-    m = re.search(r"## The tracer(.*?)(?:\n## )", doc_text, re.S)
+def code_span_kinds() -> set[str]:
+    """``SPAN_KINDS`` parsed out of trace/spans.py without importing."""
+    return _tuple_var(SPANS_PY, "SPAN_KINDS")
+
+
+def code_event_kinds() -> set[str]:
+    """``EVENT_KINDS`` parsed out of obs/flight.py without importing."""
+    return _tuple_var(FLIGHT_PY, "EVENT_KINDS")
+
+
+def _doc_kind_table(doc_text: str, header_re: str, stop_re: str,
+                    what: str) -> set[str]:
+    """First-cell backticked tokens of the kind table in one section
+    (rows look like ``| `enqueue`        | cores ... |``)."""
+    m = re.search(header_re + r"(.*?)(?:" + stop_re + ")", doc_text, re.S)
     if not m:
         raise AssertionError(
-            "docs/OBSERVABILITY.md has no '## The tracer' section")
+            f"docs/OBSERVABILITY.md has no '{what}' section")
     kinds = set()
     for line in m.group(1).splitlines():
         cell = re.match(r"\|\s*`([a-z0-9-]+)`\s*\|", line)
         if cell:
             kinds.add(cell.group(1))
     if not kinds:
-        raise AssertionError("no span-kind table rows found in the doc")
+        raise AssertionError(f"no kind table rows found in {what}")
     return kinds
+
+
+def doc_span_kinds(doc_text: str) -> set[str]:
+    return _doc_kind_table(
+        doc_text, r"## The tracer", r"\n## ", "## The tracer")
+
+
+def doc_event_kinds(doc_text: str) -> set[str]:
+    return _doc_kind_table(
+        doc_text, r"### Flight recorder", r"\n###? ", "### Flight recorder")
 
 
 def run() -> list[str]:
@@ -133,6 +188,18 @@ def run() -> list[str]:
             f"span kind '{kind}' is in the doc's kind table but not in "
             "trace.spans.SPAN_KINDS"
         )
+
+    code_e, doc_e = code_event_kinds(), doc_event_kinds(doc_text)
+    for kind in sorted(code_e - doc_e):
+        problems.append(
+            f"flight event kind '{kind}' is in obs.flight.EVENT_KINDS but "
+            "missing from the doc's flight-recorder kind table"
+        )
+    for kind in sorted(doc_e - code_e):
+        problems.append(
+            f"flight event kind '{kind}' is in the doc's flight-recorder "
+            "kind table but not in obs.flight.EVENT_KINDS"
+        )
     return problems
 
 
@@ -145,7 +212,8 @@ def main(argv=None) -> int:
         return 1
     print("lint_obs: docs/OBSERVABILITY.md and code agree "
           f"({len(code_metric_names())} metrics, "
-          f"{len(code_span_kinds())} span kinds)")
+          f"{len(code_span_kinds())} span kinds, "
+          f"{len(code_event_kinds())} flight event kinds)")
     return 0
 
 

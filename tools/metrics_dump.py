@@ -42,6 +42,26 @@ import urllib.request
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+_JSONSAFE = None
+
+
+def _json_safe(o):
+    """Delegates to tools/_jsonsafe.py (loaded by file path — this tool
+    must run standalone, via `python tools/<name>.py`, AND as an
+    importlib-loaded module with no package context)."""
+    global _JSONSAFE
+    if _JSONSAFE is None:
+        import importlib.util
+
+        p = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "_jsonsafe.py")
+        spec = importlib.util.spec_from_file_location("ck_tools_jsonsafe", p)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        _JSONSAFE = mod.json_safe
+    return _JSONSAFE(o)
+
+
 def _demo() -> None:
     """A few enqueue windows on the 2-chip virtual rig — populates the
     balancer, worker, fused, and barrier series."""
@@ -254,7 +274,8 @@ def main(argv=None) -> int:
 
             sys.stdout.write(prometheus_from_snapshot(snap))
         elif args.json:
-            print(json.dumps(snap, indent=2, sort_keys=True))
+            print(json.dumps(_json_safe(snap), indent=2, sort_keys=True,
+                  allow_nan=False))
         else:
             print(_table(snap))
         return 0
@@ -267,7 +288,8 @@ def main(argv=None) -> int:
     if args.prom:
         sys.stdout.write(prometheus_text())
     elif args.json:
-        print(json.dumps(REGISTRY.snapshot(), indent=2, sort_keys=True))
+        print(json.dumps(_json_safe(REGISTRY.snapshot()), indent=2,
+              sort_keys=True, allow_nan=False))
     else:
         print(_table(REGISTRY.snapshot()))
     return 0

@@ -26,6 +26,26 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+_JSONSAFE = None
+
+
+def _json_safe(o):
+    """Delegates to tools/_jsonsafe.py (loaded by file path — this tool
+    must run standalone, via `python tools/<name>.py`, AND as an
+    importlib-loaded module with no package context)."""
+    global _JSONSAFE
+    if _JSONSAFE is None:
+        import importlib.util
+
+        p = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "_jsonsafe.py")
+        spec = importlib.util.spec_from_file_location("ck_tools_jsonsafe", p)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        _JSONSAFE = mod.json_safe
+    return _JSONSAFE(o)
+
+
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--ns", default="1048576,4194304",
@@ -55,7 +75,7 @@ def main() -> None:
     except ValueError as e:
         ap.error(str(e))
     if args.json:
-        print(json.dumps(out))
+        print(json.dumps(_json_safe(out), allow_nan=False))
         return
     print(out["note"])
     for sz in out["sizes"]:

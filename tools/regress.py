@@ -97,6 +97,26 @@ KEY_SECTION = {
 }
 
 
+_JSONSAFE = None
+
+
+def _json_safe(o):
+    """Delegates to tools/_jsonsafe.py (loaded by file path — this tool
+    must run standalone, via `python tools/<name>.py`, AND as an
+    importlib-loaded module with no package context)."""
+    global _JSONSAFE
+    if _JSONSAFE is None:
+        import importlib.util
+
+        p = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "_jsonsafe.py")
+        spec = importlib.util.spec_from_file_location("ck_tools_jsonsafe", p)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        _JSONSAFE = mod.json_safe
+    return _JSONSAFE(o)
+
+
 def extract_tail_object(text: str, key: str) -> dict | None:
     """Recover the LAST ``"key": {...}`` object from possibly-truncated
     JSON text by balanced-brace scanning (string-aware).  Returns None
@@ -172,9 +192,13 @@ def load_headline(path: str) -> dict:
             out["sections"] = parsed
             return out
         text = doc.get("tail") or ""
-    # truncated tail (or unknown shape): recover the trailing objects
+    # truncated tail (or unknown shape): recover the trailing objects.
+    # `out` is the linter's PARSED VIEW of an artifact, not an artifact
+    # itself — key order here carries no tail-survival contract
     out["headline"] = extract_tail_object(text, "headline")
+    # ckcheck: ok parsed view, not an artifact — headline-last n/a
     out["errors"] = extract_tail_object(text, "errors")
+    # ckcheck: ok parsed view, not an artifact — headline-last n/a
     out["null_sections"] = extract_tail_object(text, "null_sections")
     return out
 
@@ -443,7 +467,7 @@ def main(argv=None) -> int:
     verdict["against"] = args.against
     verdict["candidate"] = cand_path
     if args.json:
-        print(json.dumps(verdict, indent=2))
+        print(json.dumps(_json_safe(verdict), indent=2, allow_nan=False))
     else:
         status = "OK" if verdict["ok"] else "FAIL"
         print(f"regress {status}: {verdict['checked']} keys checked vs "
