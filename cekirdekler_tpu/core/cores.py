@@ -207,6 +207,10 @@ class Cores:
         # dict stays as the per-cruncher API (tests and nbody_e2e read
         # it); the metrics registry carries the same counts process-wide
         # (ck_fused_* series) for the uniform Prometheus/artifact export.
+        # Writes hold the scheduler lock / fused mutex; READERS (bench
+        # delta snapshots, /statusz) are reporting-only and tolerate a
+        # mid-window value by design — the counters only ever grow.
+        # ckcheck: ok reporting-only reads; monotone counters, snapshot semantics
         self.fused_stats: dict[str, Any] = {
             "windows": 0, "fused_iters": 0, "deferred_iters": 0,
             "disengaged": {},
@@ -249,7 +253,11 @@ class Cores:
             "transfer-autotuner re-tunes forced by re-partitions")
         # observability: per-lane chunk count of the last streamed phase
         # (the autotuner's live choice; also exported as the
-        # ck_stream_chunk_count gauge)
+        # ck_stream_chunk_count gauge).  Written on the phase thread
+        # under the worker lock; readers (workloads reporting, /statusz)
+        # take no lock by design — a one-phase-stale chunk count is
+        # reporting, not a decision input.
+        # ckcheck: ok reporting-only reads; one-slot-per-lane, stale tolerated
         self.last_stream_chunks: dict[int, int] = {}
         # per-cid fence splitting (VERDICT r5 #8): when on, barrier()
         # fences each compute id's last output in last-dispatch order and

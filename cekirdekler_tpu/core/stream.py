@@ -78,6 +78,7 @@ from .worker import _ladder
 
 __all__ = [
     "chunk_plan",
+    "plan_signature",
     "TransferTuner",
     "CHUNK_CANDIDATES",
     "BOOTSTRAP_BYTES",
@@ -136,6 +137,22 @@ def chunk_plan(size: int, step: int, target: int) -> list[tuple[int, int]]:
         out.append((off, s))
         off += s
     return out
+
+
+def plan_signature(plan) -> str:
+    """Canonical "blocks" signature of a chunk/ladder geometry:
+    descending chunk sizes joined with ``+`` (e.g. ``"4096+2048+512"``).
+
+    Accepts :func:`chunk_plan` output (``[(offset, size), ...]``) or a
+    bare size list (``worker._ladder`` output).  This string is the
+    ``blocks`` component of kernel-profile store keys
+    (``trace/device.ProfileStore``) — the same kernel at two chunk
+    geometries is two different device-time stories, and launch marks
+    correlated per geometry must never collide in the store."""
+    sizes = [
+        int(p[1]) if isinstance(p, (tuple, list)) else int(p) for p in plan
+    ]
+    return "+".join(str(s) for s in sizes) or "0"
 
 
 @dataclass

@@ -36,6 +36,7 @@ from ..arrays.clarray import ClArray
 from ..kernel.registry import KernelProgram
 from ..metrics.registry import REGISTRY
 from ..obs.flight import FLIGHT
+from ..trace.device import MARKS
 from ..trace.spans import TRACER
 from ..utils.markers import MarkerCounter
 
@@ -533,46 +534,57 @@ class Worker:
         bufs = tuple(self._buffers[id(p)] for p in params)
         names = list(kernel_names)
         dispatched = 0
-        seq_fn = None
-        if repeats > 1:
-            # on-device repeat: the whole sequence × repeats is ONE fused
-            # dispatch (lax.fori_loop inside jit) — no host round-trips
-            # (reference: computeRepeated, Worker.cs:36-46)
-            seq_fn = program.sequence_launcher(
-                tuple(names), tuple(_ladder(size, step)), local_range,
-                global_size, repeats, sync_kernel, value_args,
-                platform=self.device.platform,
-            )
-        if seq_fn is not None:
-            bufs = tuple(seq_fn(offset, bufs))
-            dispatched = 1
-        else:
-            # host-loop fallback (unhashable values): interleave the sync
-            # kernel between repeats like computeRepeatedWithSyncKernel
-            if repeats > 1 and sync_kernel:
-                seq: list[str] = []
-                for r in range(repeats):
-                    seq.extend(names)
-                    if r != repeats - 1:
-                        seq.append(sync_kernel)
-                plan = [(seq, 1)]
+        # device-timeline mark around the dispatch (trace/device.py):
+        # disabled is one attribute read + falsy check, the tracer
+        # discipline — the annotation correlates this launch's device
+        # ops back to (cid, lane, kernel, seq)
+        _dm = MARKS.begin(names, compute_id, self.index) \
+            if MARKS.enabled else None
+        try:
+            seq_fn = None
+            if repeats > 1:
+                # on-device repeat: the whole sequence × repeats is ONE
+                # fused dispatch (lax.fori_loop inside jit) — no host
+                # round-trips (reference: computeRepeated, Worker.cs:36-46)
+                seq_fn = program.sequence_launcher(
+                    tuple(names), tuple(_ladder(size, step)), local_range,
+                    global_size, repeats, sync_kernel, value_args,
+                    platform=self.device.platform,
+                )
+            if seq_fn is not None:
+                bufs = tuple(seq_fn(offset, bufs))
+                dispatched = 1
             else:
-                plan = [(names, repeats)]
-            for names_seq, reps in plan:
-                for _ in range(reps):
-                    for name in names_seq:
-                        va = value_args.get(name, ()) if isinstance(value_args, dict) else tuple(value_args)
-                        for chunk in _ladder(size, step):
-                            fn, info = program.launcher(
-                                name, chunk, local_range, global_size,
-                                platform=self.device.platform,
-                            )
-                            n_arr = program.array_param_count(name)
-                            out = fn(offset, bufs[:n_arr], tuple(va))
-                            bufs = tuple(out) + bufs[n_arr:]
-                            offset += chunk
-                            dispatched += 1
-                        offset -= size  # rewind for next kernel/repeat
+                # host-loop fallback (unhashable values): interleave the
+                # sync kernel between repeats like
+                # computeRepeatedWithSyncKernel
+                if repeats > 1 and sync_kernel:
+                    seq: list[str] = []
+                    for r in range(repeats):
+                        seq.extend(names)
+                        if r != repeats - 1:
+                            seq.append(sync_kernel)
+                    plan = [(seq, 1)]
+                else:
+                    plan = [(names, repeats)]
+                for names_seq, reps in plan:
+                    for _ in range(reps):
+                        for name in names_seq:
+                            va = value_args.get(name, ()) if isinstance(value_args, dict) else tuple(value_args)
+                            for chunk in _ladder(size, step):
+                                fn, info = program.launcher(
+                                    name, chunk, local_range, global_size,
+                                    platform=self.device.platform,
+                                )
+                                n_arr = program.array_param_count(name)
+                                out = fn(offset, bufs[:n_arr], tuple(va))
+                                bufs = tuple(out) + bufs[n_arr:]
+                                offset += chunk
+                                dispatched += 1
+                            offset -= size  # rewind for next kernel/repeat
+        finally:
+            if _dm is not None:  # close even on a failed dispatch
+                MARKS.end(_dm)
         for p, b in zip(params, bufs):
             self._buffers[id(p)] = b
         if bufs:
@@ -639,7 +651,16 @@ class Worker:
                 )
             return
         bufs = tuple(self._buffers[id(p)] for p in params)
-        bufs = tuple(fn(offset, size // step, iters, bufs))
+        # device-timeline mark (trace/device.py): the fused ladder is ONE
+        # dispatch, so one mark covers all `iters` iterations; the
+        # per-iteration fallback above marks inside launch() instead
+        _dm = MARKS.begin(kernel_names, compute_id, self.index) \
+            if MARKS.enabled else None
+        try:
+            bufs = tuple(fn(offset, size // step, iters, bufs))
+        finally:
+            if _dm is not None:
+                MARKS.end(_dm)
         for p, b in zip(params, bufs):
             self._buffers[id(p)] = b
         if bufs:

@@ -32,9 +32,18 @@ __all__ = [
 
 
 def _fmt(v: float) -> str:
-    if isinstance(v, float) and v == int(v) and abs(v) < 1e15:
-        return str(int(v))
-    return repr(v) if isinstance(v, float) else str(v)
+    if isinstance(v, float):
+        # Prometheus exposition spells non-finite values +Inf/-Inf/NaN;
+        # int(inf) raises, which used to 500 the whole /metrics page
+        # over one inf gauge
+        if v != v:
+            return "NaN"
+        if v in (float("inf"), float("-inf")):
+            return "+Inf" if v > 0 else "-Inf"
+        if v == int(v) and abs(v) < 1e15:
+            return str(int(v))
+        return repr(v)
+    return str(v)
 
 
 def _split_series(series: str) -> tuple[str, str]:

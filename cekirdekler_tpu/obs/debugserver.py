@@ -25,6 +25,10 @@ Endpoints (all GET, all JSON unless noted):
   liveness gate.
 - ``/flightz`` — the flight recorder's event ring + a registry
   snapshot: the black box, readable before the crash.
+- ``/profilez`` — the last device-timeline capture's reconciled
+  per-kernel report (``trace/device.py``; a NAMED absence on rigs
+  whose backend exposes no device tracks), mark-plane state, and the
+  persistent kernel-profile store's index.
 
 Lock discipline (the hot-path contract): every endpoint reads
 SNAPSHOTS — ``REGISTRY.snapshot()`` copies under the registry lock,
@@ -137,6 +141,7 @@ class DebugServer:
             "/tracez": self._tracez,
             "/healthz": self._healthz,
             "/flightz": self._flightz,
+            "/profilez": self._profilez,
         }.get(url.path)
         if route is None:
             self._reply(h, 404, _json_bytes(
@@ -157,7 +162,7 @@ class DebugServer:
     def _index(self, h, q) -> None:
         self._reply(h, 200, _json_bytes({
             "endpoints": ["/metrics", "/statusz", "/tracez", "/healthz",
-                          "/flightz"],
+                          "/flightz", "/profilez"],
             "uptime_s": round(time.time() - self._t0, 3),
         }))
 
@@ -282,6 +287,14 @@ class DebugServer:
             "events": [e.to_row() for e in FLIGHT.snapshot()],
             "metrics": REGISTRY.snapshot(),
         }))
+
+    def _profilez(self, h, q) -> None:
+        # profilez_payload reads the last-report slot under its own
+        # lock and lists store FILENAMES only (no row bodies) — the
+        # same snapshot-copy discipline as every other endpoint
+        from ..trace.device import profilez_payload
+
+        self._reply(h, 200, _json_bytes(profilez_payload()))
 
     # -- lifecycle -----------------------------------------------------------
     def close(self) -> None:

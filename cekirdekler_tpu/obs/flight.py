@@ -46,7 +46,9 @@ transfer autotuner's chunk count changed for a lane),
 ``barrier`` (sync point, with per-lane fence ms), ``driver-error``
 (a dispatch-driver closure failed), ``metrics-sample`` (periodic
 registry snapshot), ``crash`` (an exception surfaced at a wired
-boundary).
+boundary), ``profiler-start`` / ``profiler-stop`` (a device-timeline
+capture opened/closed — ``trace/device.DeviceCapture``; a postmortem
+shows whether the crash happened under capture).
 """
 
 from __future__ import annotations
@@ -87,6 +89,7 @@ EVENT_KINDS = (
     "stream-choice", "stream-retune",
     "barrier", "driver-error", "metrics-sample", "crash",
     "debug-server", "debug-port-skipped",
+    "profiler-start", "profiler-stop",
 )
 
 #: Postmortem JSON schema tag — bump on incompatible changes.

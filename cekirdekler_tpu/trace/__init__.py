@@ -21,6 +21,11 @@ Five pieces (see ``docs/OBSERVABILITY.md`` for the guided tour):
   ship span batches + metric snapshots with RTT-symmetric clock-offset
   estimation, producing ONE merged, alignment-checked Perfetto trace
   for an N-process job.
+- :mod:`.device` — device-timeline attribution on the ``jax.profiler``
+  capture seam: launch marks (``MARKS``), per-kernel device profiles
+  reconciled against the host window, roofline rows, the unified
+  host+device Perfetto export, and the persistent on-disk kernel-
+  profile store (``CK_PROFILE_STORE``).
 
 None of these import jax at module level: enabling tracing costs no
 backend initialization.
@@ -35,6 +40,19 @@ from .aggregate import (
 )
 from .attribution import AttributionReport, split_fence_benches, window_report
 from .ceiling import RepSample, ceiling_report, rep_ceiling
+from .device import (
+    DEVICE_SPAN_KINDS,
+    MARKS,
+    STORE,
+    DeviceCapture,
+    DeviceWindowReport,
+    ProfileStore,
+    capture_device,
+    profilez_payload,
+    roofline_row,
+    split_unified_trace,
+    unified_chrome_trace,
+)
 from .export import (
     from_chrome_trace,
     save_chrome_trace,
@@ -46,20 +64,30 @@ from .spans import SPAN_KINDS, TRACER, Span, Tracer, tracing
 __all__ = [
     "AttributionReport",
     "ClusterSnapshot",
+    "DEVICE_SPAN_KINDS",
+    "DeviceCapture",
+    "DeviceWindowReport",
+    "MARKS",
+    "ProfileStore",
     "RepSample",
     "SPAN_KINDS",
+    "STORE",
     "Span",
     "TRACER",
     "Tracer",
+    "capture_device",
     "ceiling_report",
     "collective_consistency",
     "estimate_clock_offsets",
     "from_chrome_trace",
     "gather_cluster",
     "merged_chrome_trace",
+    "profilez_payload",
     "rep_ceiling",
+    "roofline_row",
     "save_chrome_trace",
     "split_fence_benches",
+    "split_unified_trace",
     "text_table",
     "to_chrome_trace",
     "tracing",

@@ -24,7 +24,10 @@ import os
 from contextlib import contextmanager
 from dataclasses import dataclass, field
 
-__all__ = ["DeviceTimeline", "Tracer", "capture", "analyze_trace_dir"]
+__all__ = [
+    "DeviceTimeline", "Tracer", "capture", "analyze_trace_dir",
+    "load_trace_events", "start_profiler", "stop_profiler",
+]
 
 
 @dataclass
@@ -62,18 +65,45 @@ def _merged_busy(intervals: list[tuple[float, float]]) -> float:
     return total + (cur_e - cur_s)
 
 
-def analyze_trace_dir(trace_dir: str) -> DeviceTimeline:
-    """Parse the newest ``*.trace.json.gz`` under ``trace_dir`` and reduce
-    the device-side "XLA Ops" tracks to busy/span statistics."""
+def load_trace_events(trace_dir: str) -> tuple[str | None, list]:
+    """(path, traceEvents) of the newest trace-event dump under
+    ``trace_dir`` — the shared loader behind :func:`analyze_trace_dir`
+    and ``trace/device.py``'s richer parse.  Accepts both the gzipped
+    form every ``jax.profiler.trace`` on a JSON-emitting backend writes
+    (``*.trace.json.gz``) and a plain ``*.trace.json`` (synthetic
+    fixtures, hand-converted dumps).  Returns ``(None, [])`` when the
+    directory holds no dump or the newest one does not parse — callers
+    degrade to an empty analysis, never raise."""
     files = glob.glob(
         os.path.join(trace_dir, "**", "*.trace.json.gz"), recursive=True
+    ) + glob.glob(
+        os.path.join(trace_dir, "**", "*.trace.json"), recursive=True
     )
     if not files:
-        return DeviceTimeline()
+        return None, []
     path = max(files, key=os.path.getmtime)
-    with gzip.open(path, "rt") as f:
-        trace = json.load(f)
-    events = trace.get("traceEvents", [])
+    try:
+        if path.endswith(".gz"):
+            with gzip.open(path, "rt") as f:
+                trace = json.load(f)
+        else:
+            with open(path) as f:
+                trace = json.load(f)
+    except (OSError, json.JSONDecodeError, UnicodeDecodeError, EOFError):
+        return None, []
+    # real dumps are ``{"traceEvents": [...]}``; some converters emit
+    # the bare event array — accept both (the r8 real-format check)
+    if isinstance(trace, list):
+        return path, trace
+    return path, trace.get("traceEvents", [])
+
+
+def analyze_trace_dir(trace_dir: str) -> DeviceTimeline:
+    """Parse the newest trace dump under ``trace_dir`` and reduce
+    the device-side "XLA Ops" tracks to busy/span statistics."""
+    path, events = load_trace_events(trace_dir)
+    if path is None:
+        return DeviceTimeline()
     device_pids: dict[int, str] = {}
     op_tracks: set[tuple[int, int]] = set()
     for e in events:
@@ -109,6 +139,32 @@ def analyze_trace_dir(trace_dir: str) -> DeviceTimeline:
     )
 
 
+def start_profiler(trace_dir: str):
+    """Start a ``jax.profiler`` trace into ``trace_dir`` — the capture
+    seam ``trace/device.py`` builds on.  Returns ``(handle, None)`` on
+    success or ``(None, reason)`` when profiling is unavailable (the
+    region should still run; degrade to a named absence)."""
+    try:
+        import jax
+
+        prof = jax.profiler.trace(trace_dir)
+        prof.__enter__()
+        return prof, None
+    except Exception as e:  # noqa: BLE001 - unavailability is a reason
+        return None, f"{type(e).__name__}: {e}"
+
+
+def stop_profiler(handle) -> None:
+    """Stop a profiler started by :func:`start_profiler` (best-effort:
+    Xprof teardown failures never mask the region's own outcome)."""
+    if handle is None:
+        return
+    try:
+        handle.__exit__(None, None, None)
+    except Exception:  # noqa: BLE001
+        pass
+
+
 @contextmanager
 def capture(trace_dir: str):
     """Capture a device timeline around a region::
@@ -122,23 +178,16 @@ def capture(trace_dir: str):
     profile, the region still runs and the analysis is empty.  Exceptions
     raised INSIDE the region propagate unchanged (profiler stopped
     best-effort) — only profiler-start failures are swallowed."""
-    import jax
-
     state: dict = {}
-    try:
-        prof = jax.profiler.trace(trace_dir)
-        prof.__enter__()
-    except Exception:
+    prof, _err = start_profiler(trace_dir)
+    if prof is None:
         # profiling unavailable: run the region untraced rather than fail
         yield lambda: state.setdefault("tl", DeviceTimeline())
         return
     try:
         yield lambda: state.setdefault("tl", analyze_trace_dir(trace_dir))
     finally:
-        try:
-            prof.__exit__(None, None, None)
-        except Exception:
-            pass
+        stop_profiler(prof)
 
 
 class Tracer:

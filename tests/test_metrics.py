@@ -296,6 +296,22 @@ def test_prometheus_text_format():
     assert replay.strip() == live_no_help.strip()
 
 
+def test_prometheus_text_survives_nonfinite_gauge():
+    """One inf/nan gauge must not 500 the whole /metrics page: the
+    exposition format spells them +Inf/-Inf/NaN (the int(inf) crash the
+    ISSUE-8 verify drive surfaced)."""
+    reg = MetricsRegistry()
+    reg.gauge("ck_d", "drive").set(float("inf"))
+    reg.gauge("ck_e", "drive").set(float("-inf"))
+    reg.gauge("ck_f", "drive").set(float("nan"))
+    reg.counter("ck_ok_total", "sane neighbor").inc()
+    text = prometheus_text(reg)
+    assert "ck_d +Inf" in text
+    assert "ck_e -Inf" in text
+    assert "ck_f NaN" in text
+    assert "ck_ok_total 1" in text  # the rest of the page still renders
+
+
 def test_counter_tracks_merge_into_chrome_trace():
     """Sampled series ride the span export as Perfetto counter events
     (ph C) on the same relative timeline; the span round-trip reader

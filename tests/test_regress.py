@@ -534,6 +534,81 @@ def test_history_table_empty_root(tmp_path):
     assert "no BENCH_r*.json" in regress.history_table(str(tmp_path))
 
 
+def test_history_table_renders_missing_rounds_as_gaps(tmp_path):
+    """r03/r04 absent between r02 and r05 → gap columns with `-`
+    cells, DISTINCT from `null` (the round ran but starved the key)."""
+    root = str(tmp_path)
+    for n, mpix in ((1, 240.0), (2, 250.0), (5, None)):
+        _write_round(root, n, {"mandelbrot_mpix": mpix,
+                               "vs_tuned_loop": 1.0})
+    table = regress.history_table(root)
+    header = table.splitlines()[0]
+    for col in ("r01", "r02", "r03", "r04", "r05"):
+        assert col in header, table
+    mandel = next(ln for ln in table.splitlines()
+                  if ln.startswith("mandelbrot_mpix"))
+    cells = mandel.split()
+    # key, r01, r02, gap, gap, null, CV, tol
+    assert cells[1:6] == ["240", "250", "-", "-", "null"], table
+
+
+def test_cli_empty_trajectory_is_actionable_single_line(tmp_path):
+    """(ISSUE 8 satellite) No parseable artifact → ONE actionable line
+    on stderr and exit 1, never a traceback — for both the gating flow
+    and --history."""
+    root = str(tmp_path)
+    # a binary/corrupt artifact: the shape that used to traceback
+    # (UnicodeDecodeError inside load_headline)
+    with open(os.path.join(root, "BENCH_r01.json"), "wb") as f:
+        f.write(b"\x80\x81\xffnot json")
+
+    def run(*args):
+        return subprocess.run(
+            [sys.executable, os.path.join(ROOT, "tools", "regress.py"),
+             "--root", root, *args],
+            capture_output=True, text=True,
+        )
+
+    r = run("--against", os.path.join(root, "BENCH_r01.json"))
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "Traceback" not in r.stderr and "Traceback" not in r.stdout
+    assert "parses to a headline" in r.stderr
+    assert len([ln for ln in r.stderr.splitlines() if ln.strip()]) == 1
+
+    h = run("--history")
+    assert h.returncode == 0, h.stdout + h.stderr
+    assert "Traceback" not in h.stderr
+    assert "parses to a headline" in h.stdout
+
+    # a genuinely EMPTY root names the bootstrap action
+    empty = str(tmp_path / "empty")
+    os.makedirs(empty)
+    r2 = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "regress.py"),
+         "--root", empty, "--against", "BENCH_r99.json"],
+        capture_output=True, text=True,
+    )
+    assert r2.returncode == 1
+    assert "no BENCH_r*.json artifacts" in r2.stderr
+    assert "bench.py" in r2.stderr and "Traceback" not in r2.stderr
+
+
+def test_cli_explicit_candidate_bypasses_trajectory_check(tmp_path):
+    """--candidate is an explicit pair diff: it must keep working even
+    when the ROOT trajectory is empty/corrupt."""
+    root = str(tmp_path)
+    base = tmp_path / "base.json"
+    cand = tmp_path / "cand.json"
+    base.write_text(json.dumps({"headline": dict(HEADLINE)}))
+    cand.write_text(json.dumps({"headline": dict(HEADLINE)}))
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "regress.py"),
+         "--root", root, "--against", str(base), "--candidate", str(cand)],
+        capture_output=True, text=True,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
 def test_main_history_flag_short_circuits(tmp_path, capsys):
     _write_round(str(tmp_path), 1, HEADLINE)
     rc = regress.main(["--history", "--root", str(tmp_path)])

@@ -33,10 +33,13 @@ DEFAULT_BASELINE = os.path.join(
     os.path.dirname(os.path.abspath(__file__)), "baseline.json")
 
 #: The declared hot set: the fused deferral path, the driver-queue
-#: submit paths, the flight-ring append, and the tracer record paths.
-#: Anything these reach (minus `# ckcheck: cold` window boundaries)
-#: must obey the cached-handle / allowlisted-lock / no-alloc-telemetry
-#: discipline.
+#: submit paths, the flight-ring append, the tracer record paths, and
+#: the device-capture correlation marks (every ladder/chunk launch
+#: calls begin/end behind a plain `.enabled` guard — annotation work
+#: must stay behind that guard and never grow a lock or a registry
+#: get-or-create).  Anything these reach (minus `# ckcheck: cold`
+#: window boundaries) must obey the cached-handle / allowlisted-lock /
+#: no-alloc-telemetry discipline.
 HOT_ROOTS = (
     "core.cores.Cores._fused_defer",
     "core.worker._DriverQueue.submit",
@@ -46,6 +49,8 @@ HOT_ROOTS = (
     "trace.spans.Tracer.t0",
     "trace.spans.Tracer.record",
     "trace.spans.Tracer.instant",
+    "trace.device.DeviceMarks.begin",
+    "trace.device.DeviceMarks.end",
 )
 
 #: Locks the hot path may take: the scheduler lock + fused-window mutex

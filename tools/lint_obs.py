@@ -22,6 +22,14 @@ the lint can run anywhere, including rigs where jax is broken):
   ``obs/flight.py`` must match the kind table in the doc's flight-
   recorder section exactly, both directions (PR 7; emitted-vs-declared
   is ``tools/ckcheck``'s invariant pass).
+- **Device-track kinds.**  The ``DEVICE_SPAN_KINDS`` tuple in
+  ``trace/device.py`` must match the device-track kind table in the
+  doc's device-timeline section, both directions (ISSUE 8).
+- **Debug endpoints.**  Every route the debug server serves
+  (``obs/debugserver.py``'s routing dict, parsed by regex) must have a
+  row in the doc's endpoint table, and every documented endpoint must
+  be routed — a ``/profilez`` that exists only in prose (or only in
+  code) is drift.
 
 Exit 0 clean; exit 1 with the diff printed.  Runs as a tier-1 test
 (``tests/test_lint_obs.py``), so a PR adding a ``ck_`` series without
@@ -40,6 +48,12 @@ DOC = os.path.join(REPO, "docs", "OBSERVABILITY.md")
 PKG = os.path.join(REPO, "cekirdekler_tpu")
 SPANS_PY = os.path.join(PKG, "trace", "spans.py")
 FLIGHT_PY = os.path.join(PKG, "obs", "flight.py")
+DEVICE_PY = os.path.join(PKG, "trace", "device.py")
+DEBUGSERVER_PY = os.path.join(PKG, "obs", "debugserver.py")
+
+#: Route-table pattern in obs/debugserver.py: `"/path": self._handler`.
+#: The index route "/" is navigation, not an endpoint contract row.
+_ROUTE_RE = re.compile(r"\"(/[a-z]+)\"\s*:\s*self\._")
 
 #: Registration call pattern: REGISTRY.counter("ck_x", ...) — the first
 #: argument is always a string literal in this codebase (the lint EXISTS
@@ -132,6 +146,21 @@ def code_event_kinds() -> set[str]:
     return _tuple_var(FLIGHT_PY, "EVENT_KINDS")
 
 
+def code_device_kinds() -> set[str]:
+    """``DEVICE_SPAN_KINDS`` parsed out of trace/device.py."""
+    return _tuple_var(DEVICE_PY, "DEVICE_SPAN_KINDS")
+
+
+def code_endpoints() -> set[str]:
+    """The debug server's routed paths (regex over the routing dict)."""
+    out = set(_ROUTE_RE.findall(open(DEBUGSERVER_PY).read()))
+    if not out:
+        raise AssertionError(
+            "no routes found in obs/debugserver.py — route-table "
+            "pattern drifted")
+    return out
+
+
 def _doc_kind_table(doc_text: str, header_re: str, stop_re: str,
                     what: str) -> set[str]:
     """First-cell backticked tokens of the kind table in one section
@@ -158,6 +187,32 @@ def doc_span_kinds(doc_text: str) -> set[str]:
 def doc_event_kinds(doc_text: str) -> set[str]:
     return _doc_kind_table(
         doc_text, r"### Flight recorder", r"\n###? ", "### Flight recorder")
+
+
+def doc_device_kinds(doc_text: str) -> set[str]:
+    return _doc_kind_table(
+        doc_text, r"### Device-track kinds", r"\n###? ",
+        "### Device-track kinds")
+
+
+def doc_endpoints(doc_text: str) -> set[str]:
+    """First-cell backticked ``/path`` tokens of the endpoint table in
+    the debug-endpoints section."""
+    m = re.search(r"### Debug HTTP endpoints(.*?)(?:\n###? )", doc_text,
+                  re.S)
+    if not m:
+        raise AssertionError(
+            "docs/OBSERVABILITY.md has no '### Debug HTTP endpoints' "
+            "section")
+    eps = set()
+    for line in m.group(1).splitlines():
+        cell = re.match(r"\|\s*`(/[a-z]+)`\s*\|", line)
+        if cell:
+            eps.add(cell.group(1))
+    if not eps:
+        raise AssertionError("no endpoint table rows found in the "
+                             "Debug HTTP endpoints section")
+    return eps
 
 
 def run() -> list[str]:
@@ -200,6 +255,31 @@ def run() -> list[str]:
             f"flight event kind '{kind}' is in the doc's flight-recorder "
             "kind table but not in obs.flight.EVENT_KINDS"
         )
+
+    code_d, doc_d = code_device_kinds(), doc_device_kinds(doc_text)
+    for kind in sorted(code_d - doc_d):
+        problems.append(
+            f"device-track kind '{kind}' is in trace.device."
+            "DEVICE_SPAN_KINDS but missing from the doc's device-track "
+            "kind table"
+        )
+    for kind in sorted(doc_d - code_d):
+        problems.append(
+            f"device-track kind '{kind}' is in the doc's device-track "
+            "kind table but not in trace.device.DEVICE_SPAN_KINDS"
+        )
+
+    code_ep, doc_ep = code_endpoints(), doc_endpoints(doc_text)
+    for ep in sorted(code_ep - doc_ep):
+        problems.append(
+            f"debug endpoint {ep} is routed in obs/debugserver.py but "
+            "has no row in the doc's endpoint table"
+        )
+    for ep in sorted(doc_ep - code_ep):
+        problems.append(
+            f"debug endpoint {ep} is documented but not routed in "
+            "obs/debugserver.py"
+        )
     return problems
 
 
@@ -213,7 +293,9 @@ def main(argv=None) -> int:
     print("lint_obs: docs/OBSERVABILITY.md and code agree "
           f"({len(code_metric_names())} metrics, "
           f"{len(code_span_kinds())} span kinds, "
-          f"{len(code_event_kinds())} flight event kinds)")
+          f"{len(code_event_kinds())} flight event kinds, "
+          f"{len(code_device_kinds())} device-track kinds, "
+          f"{len(code_endpoints())} debug endpoints)")
     return 0
 
 

@@ -58,6 +58,7 @@ __all__ = [
     "diff_headlines",
     "bench_epilogue",
     "history_table",
+    "no_trajectory_message",
     "main",
 ]
 
@@ -168,7 +169,10 @@ def load_headline(path: str) -> dict:
     try:
         with open(path) as f:
             text = f.read()
-    except OSError as e:
+    except (OSError, UnicodeDecodeError) as e:
+        # UnicodeDecodeError: a binary/garbled artifact must degrade to
+        # "no headline" like every other unparseable shape — the CLI
+        # turns that into its one-line verdict, never a traceback
         out["errors"] = {"_load": f"{type(e).__name__}: {e}"}
         return out
     doc = None
@@ -359,33 +363,81 @@ def bench_epilogue(result: dict, repo_root: str) -> dict | None:
         return {"ok": None, "error": f"{type(e).__name__}: {e}"[:300]}
 
 
+#: history_table cell sentinel: the ROUND is missing from the on-disk
+#: trajectory (vs "null" — the round ran but starved the key).
+_GAP = object()
+
+
+def no_trajectory_message(root: str) -> str | None:
+    """The one-line actionable verdict when the trajectory cannot gate
+    anything: no artifacts at all, or none that parses to a headline.
+    Returns None when at least one artifact carries a headline."""
+    paths = _artifact_paths(root)
+    if not paths:
+        return (f"regress: no BENCH_r*.json artifacts under {root} — "
+                "run `python bench.py | tee BENCH_r<N>.json` to start a "
+                "trajectory")
+    if all(load_headline(p).get("headline") is None for p in paths):
+        return (f"regress: none of the {len(paths)} BENCH_r*.json "
+                f"artifact(s) under {root} parses to a headline block — "
+                "re-run `python bench.py` (artifacts predating the "
+                "headline contract, or truncated/corrupt, cannot gate)")
+    return None
+
+
 def history_table(root: str, watched=WATCHED_KEYS) -> str:
     """Compact per-key trajectory table over the on-disk ``BENCH_r*``
     artifacts: one row per watched key, one column per round, plus the
     trajectory CV and the effective (noise-widened) tolerance — bench
-    regressions eyeballed without opening five JSON files."""
+    regressions eyeballed without opening five JSON files.  Rounds
+    MISSING from the trajectory (r03 absent between r02 and r04) render
+    as ``-`` gap columns, distinct from ``null`` (the round ran but the
+    key starved)."""
     paths = _artifact_paths(root)
-    if not paths:
-        return f"(no BENCH_r*.json artifacts under {root})"
+    empty = no_trajectory_message(root)
+    if empty is not None:
+        return f"({empty[len('regress: '):]})" if paths else \
+            f"(no BENCH_r*.json artifacts under {root})"
     history = [load_headline(p) for p in paths]
     rounds = []
+    nums = []
     for p in paths:
         m = re.search(r"BENCH_r(\d+)", os.path.basename(p))
         rounds.append(f"r{m.group(1)}" if m else os.path.basename(p)[:8])
+        nums.append(int(m.group(1)) if m else None)
+    heads = [h.get("headline") or {} for h in history]
+    # splice gap columns for rounds absent between the first and last
+    # present round (numeric ordering — _round_key sorted the paths)
+    by_num: dict[int, tuple[str, object]] = {}
+    extras: list[tuple[str, object]] = []
+    for r, h, num in zip(rounds, heads, nums):
+        if num is None:
+            extras.append((r, h))
+        else:
+            by_num.setdefault(num, (r, h))
+    cols: list[tuple[str, object]] = []
+    if by_num:
+        for n in range(min(by_num), max(by_num) + 1):
+            cols.append(by_num.get(n, (f"r{n:02d}", _GAP)))
+    cols.extend(extras)
+    col_names = [c[0] for c in cols]
     key_w = max(len(k) for k, *_ in watched)
-    col_w = max(8, max(len(r) for r in rounds) + 1)
+    col_w = max(8, max(len(r) for r in col_names) + 1)
     lines = [
         f"{'key':<{key_w}} "
-        + "".join(f"{r:>{col_w}}" for r in rounds)
+        + "".join(f"{r:>{col_w}}" for r in col_names)
         + f" {'CV':>7} {'tol':>7}"
     ]
-    heads = [h.get("headline") or {} for h in history]
     for key, aliases, _direction, floor in watched:
-        vals = [_get(h, key, aliases) for h in heads]
-        if all(v is None for v in vals):
+        vals = [
+            _GAP if h is _GAP else _get(h, key, aliases) for _r, h in cols
+        ]
+        if all(v is None or v is _GAP for v in vals):
             continue
 
         def cell(v):
+            if v is _GAP:
+                return f"{'-':>{col_w}}"
             if v is None:
                 return f"{'null':>{col_w}}"
             return f"{v:>{col_w}.4g}"
@@ -424,9 +476,18 @@ def main(argv=None) -> int:
         return 0
     if not args.against:
         ap.error("--against is required (or use --history)")
+    # an empty/unparseable trajectory is a one-line actionable verdict,
+    # never a traceback and never a vacuous "0 keys checked" pass
+    if args.candidate is None:
+        msg = no_trajectory_message(root)
+        if msg is not None:
+            print(msg, file=sys.stderr)
+            return 1
     baseline = load_headline(args.against)
     if baseline["headline"] is None:
-        print(f"regress: no headline in baseline {args.against}",
+        print(f"regress: no headline recoverable from baseline "
+              f"{args.against} — pick a baseline artifact that carries "
+              "one (see --history), or re-run `python bench.py`",
               file=sys.stderr)
         return 1
     cand_path = args.candidate
