@@ -479,6 +479,12 @@ def balancer_rig_section():
     this process, same re-exec strategy as tests/conftest.py)."""
     env = dict(os.environ)
     env.pop("PALLAS_AXON_POOL_IPS", None)
+    # a FILE-valued CK_DECISION_LOG must not be shared with the child:
+    # its dispose-spill and the parent's would atomically replace the
+    # SAME jsonl, last writer winning (directory values are per-pid
+    # safe, but the child's synthetic convergence decisions are rig
+    # demonstration, not this process's provenance either way)
+    env.pop("CK_DECISION_LOG", None)
     env["JAX_PLATFORMS"] = "cpu"
     flags = [
         f for f in env.get("XLA_FLAGS", "").split()
@@ -586,6 +592,19 @@ class SectionScheduler:
             self.reserved[pick] = max(
                 self.reserved.get(pick, 0.0), FAIRNESS_SLICE_SEC
             )
+        try:
+            # decision provenance: the fairness promotion is a control
+            # decision like any balancer move — record its inputs (the
+            # starvation history) and the promotion it produced
+            from cekirdekler_tpu.obs.decisions import DECISIONS
+
+            if DECISIONS.enabled:
+                DECISIONS.record("scheduler-rotation", {
+                    "history": [sorted(r) for r in rounds],
+                    "rounds_seen": len(rounds),
+                }, dict(decision))
+        except Exception:  # noqa: BLE001 - provenance is best-effort here
+            pass
         return decision
 
     def spent(self) -> float:
@@ -707,10 +726,15 @@ def finalize_result(result: dict, sched: "SectionScheduler") -> dict:
        every ck_* series the run populated (balancer shares, transfer
        bytes, fused windows, fence waits, DCN traffic), the uniform
        export the per-section ad-hoc dicts never had;
-    3. the regression sentinel (tools/regress.py) diffs this run's
+    3. the decision log's in-process replay-verify verdict embeds as
+       the ``decisions`` block (counts, per-cid convergence,
+       ``replay_ok``) AND as ``headline.replay_ok`` — tools/regress.py
+       hard-fails an artifact whose controllers stopped reproducing
+       their own recorded decisions;
+    4. the regression sentinel (tools/regress.py) diffs this run's
        headline against the newest on-disk ``BENCH_r*.json`` with the
        whole trajectory as the noise model, and the verdict embeds;
-    4. insertion order is tail-survival policy: ``metrics`` and
+    5. insertion order is tail-survival policy: ``metrics`` and
        ``regression`` slot in BEFORE the tail-critical block — which is
        ``errors`` (moved back), the compact ``null_sections`` map
        (section → null-reason record, so starvation reasons survive
@@ -750,6 +774,23 @@ def finalize_result(result: dict, sched: "SectionScheduler") -> dict:
         )
     except Exception as e:  # noqa: BLE001 - resilience boundary
         result["health"] = {"error": f"{type(e).__name__}: {e}"[:200]}
+    # decision provenance (obs/decisions + obs/replay): per-kind counts,
+    # the per-cid convergence view, and the in-process replay-verify
+    # verdict.  Runs AFTER the metrics snapshot on purpose: replaying
+    # load_balance re-increments ck_balance_* counters, and those
+    # replay echoes must not land in the artifact's metrics block.  The
+    # verdict ALSO rides the headline as replay_ok so tools/regress.py
+    # (and the truncated-tail recovery) can gate on it.
+    try:
+        from cekirdekler_tpu.obs.replay import bench_decisions_summary
+
+        result["decisions"] = bench_decisions_summary()
+        replay_ok = result["decisions"].get("replay_ok")
+    except Exception as e:  # noqa: BLE001 - resilience boundary
+        result["decisions"] = {"error": f"{type(e).__name__}: {e}"[:200]}
+        replay_ok = None
+    if isinstance(result.get("headline"), dict):
+        result["headline"]["replay_ok"] = replay_ok
     try:
         here = os.path.dirname(os.path.abspath(__file__))
         regression = _load_regress().bench_epilogue(result, repo_root=here)
@@ -769,6 +810,10 @@ def finalize_result(result: dict, sched: "SectionScheduler") -> dict:
     headline["regression_ok"] = (
         regression.get("ok") if isinstance(regression, dict) else None
     )
+    if "replay_ok" not in headline:
+        # the degraded/headline-less artifact still carries the
+        # replay-verify verdict (the sentinel gates on it)
+        headline["replay_ok"] = replay_ok
     result["headline"] = headline
     return result
 

@@ -41,10 +41,14 @@ def convergence_sim(ndev: int = 8, step: int = 256) -> dict:
     cum = np.concatenate([[0.0], np.cumsum(cost)])
     n = w * h
 
-    def run(smooth: bool, adaptive: bool = True):
+    def run(smooth: bool, adaptive: bool = True, cid: int = 0):
         """Same config Cores._ranges_for uses: adaptive BalanceState +
         recency-weighted history by default; adaptive=False is the
-        reference-parity fixed-damping mode."""
+        reference-parity fixed-damping mode.  ``cid`` keys the decision
+        provenance: the four configs are four INDEPENDENT chains (each
+        resets to the equal split), and replay/what-if tooling chains
+        records per cid — one shared id would splice them into a
+        meaningless merged trajectory."""
         ranges = equal_split(n, ndev, step)
         hist = BalanceHistory(weighted=adaptive) if smooth else None
         state = BalanceState() if adaptive else None
@@ -54,14 +58,14 @@ def convergence_sim(ndev: int = 8, step: int = 256) -> dict:
             offs = np.concatenate([[0], np.cumsum(ranges)]).astype(int)
             bench = [float(cum[offs[i + 1]] - cum[offs[i]]) for i in range(ndev)]
             ranges = load_balance(bench, ranges, n, step, hist,
-                                  carry=carry, state=state)
+                                  carry=carry, state=state, cid=cid)
             traj.append(list(ranges))
         return traj
 
-    traj = run(smooth=True)
-    traj_ns = run(smooth=False)
-    traj_parity = run(smooth=True, adaptive=False)
-    traj_parity_ns = run(smooth=False, adaptive=False)
+    traj = run(smooth=True, cid=0)
+    traj_ns = run(smooth=False, cid=1)
+    traj_parity = run(smooth=True, adaptive=False, cid=2)
+    traj_parity_ns = run(smooth=False, adaptive=False, cid=3)
 
     # balanced quality: max per-chip work / mean, at first vs final split
     def imbalance(r):

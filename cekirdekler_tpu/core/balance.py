@@ -25,6 +25,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 
 from ..metrics.registry import REGISTRY
+from ..obs.decisions import DECISIONS
 from ..obs.flight import FLIGHT
 
 __all__ = [
@@ -44,6 +45,12 @@ DAMP_MAX = 0.6       # adaptive ceiling — faster than reference warm-up
 DAMP_MAX_SMOOTHED = 0.3  # ceiling when a lagging history smoother is in the loop
 DAMP_DECAY = 0.5     # on sign flip (oscillation detected)
 DAMP_GROW = 1.25     # on consistent direction
+#: Quantization-floor freeze margin: hold the split when the busiest
+#: chip's excess over the mean is below this fraction of one step's
+#: work on that chip (named so replay-verify catches a retune — a
+#: recorded log re-executed after someone edits this constant fails
+#: naming the first divergent seq).
+FREEZE_MARGIN = 0.6
 
 
 @dataclass
@@ -163,6 +170,7 @@ def load_balance(
     state: BalanceState | None = None,
     transfer_ms: list[float] | None = None,
     jump_start: bool = False,
+    cid: int | None = None,
 ) -> list[int]:
     """One balancer iteration; returns new per-chip ranges summing to
     ``total``, each a multiple of ``step`` (≥ 0).
@@ -201,10 +209,42 @@ def load_balance(
     onto a compile-inflated bench would near-starve that lane in one
     step.  One-shot per state (``BalanceState.jumped``); every later
     iteration runs the normal damped adaptive loop.
+
+    ``cid`` — provenance only: the compute id this iteration balances,
+    carried into the decision record so replay/what-if can chain one
+    id's sequence (the math never reads it).
+
+    Every iteration records one ``load-balance`` decision into
+    ``obs.decisions.DECISIONS`` with the COMPLETE inputs (benches,
+    ranges, floors, damping, and the pre-call history/carry/state
+    snapshots) and outputs (action, new ranges, shares, effective
+    times, continuous state) — the event-sourced provenance
+    ``tools/ckreplay.py`` replay-verifies bit-identically.
     """
     n = len(ranges)
     if n == 1:
         return [total]
+    # provenance snapshot at ENTRY, before the sum-repair/reset paths
+    # mutate anything: replay re-executes this call from exactly here
+    rec = None
+    if DECISIONS.enabled:
+        rec = {
+            "benchmarks": [float(b) for b in benchmarks],
+            "ranges": [int(r) for r in ranges],
+            "total": int(total), "step": int(step),
+            "damping": float(damping),
+            "transfer_ms": (None if transfer_ms is None
+                            else [float(t) for t in transfer_ms]),
+            "jump_start": bool(jump_start),
+            "cid": cid,
+            "history": None if history is None else {
+                "depth": int(history.depth),
+                "weighted": bool(history.weighted),
+                "rows": [list(r) for r in history.rows],
+            },
+            "carry": None if carry is None else list(carry),
+            "state": None if state is None else _state_snapshot(state),
+        }
     if sum(ranges) != total:
         ranges = equal_split(total, n, step)
         if carry is not None:
@@ -224,8 +264,10 @@ def load_balance(
 
     # 1-2: normalized throughput shares (measured on the quantized ranges)
     safe = [max(b, 1e-9) for b in benchmarks]
+    floor_bound = [False] * n
     if transfer_ms is not None and len(transfer_ms) == n:
         # transfer floor: effective time = max(compute bench, link time)
+        floor_bound = [max(t, 0.0) > s for s, t in zip(safe, transfer_ms)]
         safe = [max(s, max(t, 0.0)) for s, t in zip(safe, transfer_ms)]
     tot_b = sum(safe)
 
@@ -252,7 +294,7 @@ def load_balance(
         i_max = max(range(n), key=lambda k: safe[k])
         if ranges[i_max] > 0:
             one_step_work = safe[i_max] / ranges[i_max] * step
-            if safe[i_max] - mean_b < 0.6 * one_step_work:
+            if safe[i_max] - mean_b < FREEZE_MARGIN * one_step_work:
                 if history is not None:
                     history.smooth(shares)
                 state.cont = [float(r) for r in ranges]
@@ -262,6 +304,27 @@ def load_balance(
                     "quantization-floor freezes (split held, churn avoided)",
                 ).inc()
                 FLIGHT.event("balance-freeze", ranges=list(ranges))
+                if rec is not None:
+                    DECISIONS.record("load-balance", rec, {
+                        "action": "freeze",
+                        "ranges": [int(r) for r in ranges],
+                        "shares": list(shares),
+                        "effective_ms": list(safe),
+                        "floor_bound": list(floor_bound),
+                        "cont": [float(r) for r in ranges],
+                        "freeze": {
+                            "mean_ms": mean_b,
+                            "one_step_work_ms": one_step_work,
+                            "excess_ms": safe[i_max] - mean_b,
+                            "lane": i_max,
+                            # the margin IN EFFECT at decision time —
+                            # explain must render the constant this
+                            # freeze actually compared against, not
+                            # whatever the code ships later
+                            "margin": FREEZE_MARGIN,
+                        },
+                        "state_after": _state_snapshot(state),
+                    })
                 return list(ranges)
 
     # 3: optional smoothing
@@ -274,13 +337,16 @@ def load_balance(
     do_jump = (
         state is not None and jump_start and not state.jumped and state.warm
     )
+    jump_armed = False
     if state is not None and jump_start and not state.jumped and not state.warm:
+        jump_armed = True
         # arm only: first-window benches routinely carry one lane's jit
         # compile and the tuner's measuring fence — jumping undamped
         # onto a compile-inflated bench would near-starve that lane in
         # one step, so this iteration runs damped and the NEXT measured
         # rebalance jumps on clean benches
         state.warm = True
+    action = "jump" if do_jump else ("damped" if state is not None else "fixed")
     if do_jump:
         # transfer-aware warm start: one undamped jump to the
         # rate-implied split (second-window benches carry per-item cost
@@ -346,4 +412,29 @@ def load_balance(
             i = max(candidates, key=lambda k: quant[k])
             quant[i] -= step
             diff += step
+    if rec is not None:
+        DECISIONS.record("load-balance", rec, {
+            "action": action,
+            "jump_armed": jump_armed,
+            "ranges": [int(x) for x in quant],
+            "shares": list(shares),
+            "effective_ms": list(safe),
+            "floor_bound": list(floor_bound),
+            "cont": list(cont),
+            "state_after": _state_snapshot(state),
+        })
     return quant
+
+
+def _state_snapshot(state: BalanceState | None) -> dict | None:
+    """The replay-sufficient view of a :class:`BalanceState` — every
+    field the next iteration's math reads."""
+    if state is None:
+        return None
+    return {
+        "cont": list(state.cont),
+        "prev_delta": list(state.prev_delta),
+        "damp": list(state.damp),
+        "jumped": state.jumped,
+        "warm": state.warm,
+    }

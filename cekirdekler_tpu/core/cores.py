@@ -40,6 +40,7 @@ from ..hardware import Devices
 from ..kernel.registry import KernelProgram
 from ..metrics.registry import REGISTRY
 from ..obs.debugserver import DEBUG_PORT_ENV
+from ..obs.decisions import DECISIONS
 from ..obs.flight import FLIGHT, record_crash
 from ..obs.health import HealthMonitor
 from ..trace.attribution import split_fence_benches
@@ -402,10 +403,12 @@ class Cores:
                     ranges = load_balance(
                         bench, ranges, total, step, hist, state=state,
                         transfer_ms=transfer, jump_start=True,
+                        cid=compute_id,
                     )
                 else:
                     carry = self._cont_ranges.setdefault(compute_id, [])
-                    ranges = load_balance(bench, ranges, total, step, hist, carry=carry)
+                    ranges = load_balance(bench, ranges, total, step, hist,
+                                          carry=carry, cid=compute_id)
         self.global_ranges[compute_id] = ranges
         refs = [0] * n
         acc = 0
@@ -841,6 +844,16 @@ class Cores:
             self._fused_sig = sig
             self._fused_run = run
         FLIGHT.event("fused-engage", cid=compute_id, rows=len(rows))
+        if DECISIONS.enabled:
+            # provenance (not replayable: the engage check reads LIVE
+            # device residency) — what signature fused, on which lanes
+            DECISIONS.record("fused-engage", {
+                "cid": compute_id,
+                "kernels": list(kernel_names),
+                "global_range": global_range,
+                "local_range": local_range,
+                "lanes": [w.index for w, _off, _size in rows],
+            }, {"engaged": True, "rows": len(rows)})
 
     def _fused_defer(self, t_start: float, kernel_names) -> bool:
         """Count this call into the active fused window.  Returns False
@@ -952,6 +965,9 @@ class Cores:
             reason=reason,
         ).inc()
         FLIGHT.event("fused-disengage", reason=reason, cid=cid)
+        if DECISIONS.enabled:
+            DECISIONS.record(
+                "fused-disengage", {"cid": cid}, {"reason": reason})
         TRACER.instant("fused", cid=cid, tag=f"disengage:{reason}")
 
     def _fused_break(self, reason: str) -> None:
@@ -2070,6 +2086,10 @@ class Cores:
             # periodic metric sample into the flight ring (throttled —
             # at most one per FLIGHT.sample_interval_s)
             FLIGHT.maybe_sample_metrics()
+            # throttled decision-log jsonl spill (armed by
+            # CK_DECISION_LOG; a no-op attribute check otherwise) — the
+            # barrier is the coldest periodic point the runtime has
+            DECISIONS.maybe_spill()
             # always close the window — a fence failure must not leave a
             # stale t0/cid set to corrupt the NEXT window's benches
             self._enqueue_window_closed()
@@ -2091,6 +2111,8 @@ class Cores:
         if self._debug_server is not None:
             self._debug_server.close()
             self._debug_server = None
+        # the last chance to persist the decision tail (armed rigs only)
+        DECISIONS.maybe_spill(force=True)
         for w in self.workers:
             w.dispose()
         self.pool.shutdown(wait=False)

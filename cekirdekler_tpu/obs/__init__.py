@@ -16,11 +16,25 @@ Three pillars over the r7 tracer and r9 metrics registry (see
   stream-stall walls with an N×-threshold + hysteresis degradation
   detector; advisory verdicts only (``suggest_drain`` names lanes, the
   elastic tier — ROADMAP item 4 — is the consumer that will act).
+- :mod:`.decisions` — decision PROVENANCE: the event-sourced log of
+  every controller decision with inputs sufficient to reproduce it;
+  :mod:`.replay` + ``tools/ckreplay.py`` replay-verify it bit-
+  identically, run counterfactual what-ifs, and render the ``explain``
+  causality tables (also served live on ``/decisionz``).
 
 No jax imports at module level — the plane costs no backend
 initialization (same contract as ``trace``/``metrics``).
 """
 
+from .decisions import (
+    DECISION_KINDS,
+    DECISION_LOG_ENV,
+    DECISIONS,
+    REPLAYABLE_KINDS,
+    DecisionLog,
+    DecisionRecord,
+    load_decision_log,
+)
 from .debugserver import DEBUG_PORT_ENV, DebugServer, serve_debug
 from .flight import (
     FLIGHT,
@@ -36,20 +50,29 @@ from .health import (
     VERDICTS,
     HealthMonitor,
     cluster_health_table,
+    evaluate_window,
     registry_health_summary,
 )
 
 __all__ = [
     "DEBUG_PORT_ENV",
+    "DECISIONS",
+    "DECISION_KINDS",
+    "DECISION_LOG_ENV",
     "DebugServer",
+    "DecisionLog",
+    "DecisionRecord",
     "FLIGHT",
     "FlightEvent",
     "FlightRecorder",
     "HealthMonitor",
     "POSTMORTEM_DIR_ENV",
+    "REPLAYABLE_KINDS",
     "VERDICTS",
     "cluster_health_table",
     "dump_postmortem",
+    "evaluate_window",
+    "load_decision_log",
     "load_postmortem",
     "postmortem_spans",
     "record_crash",

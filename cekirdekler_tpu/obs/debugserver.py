@@ -29,6 +29,11 @@ Endpoints (all GET, all JSON unless noted):
   per-kernel report (``trace/device.py``; a NAMED absence on rigs
   whose backend exposes no device tracks), mark-plane state, and the
   persistent kernel-profile store's index.
+- ``/decisionz`` — the decision-provenance plane (``obs/decisions.py``):
+  ring state, per-kind decision counts, the most recent records, and
+  the latest split's per-lane causality table per compute id (the live
+  ``explain``; ``tools/ckreplay.py explain`` renders the same thing
+  from a spilled log).
 
 Lock discipline (the hot-path contract): every endpoint reads
 SNAPSHOTS — ``REGISTRY.snapshot()`` copies under the registry lock,
@@ -142,6 +147,7 @@ class DebugServer:
             "/healthz": self._healthz,
             "/flightz": self._flightz,
             "/profilez": self._profilez,
+            "/decisionz": self._decisionz,
         }.get(url.path)
         if route is None:
             self._reply(h, 404, _json_bytes(
@@ -162,7 +168,7 @@ class DebugServer:
     def _index(self, h, q) -> None:
         self._reply(h, 200, _json_bytes({
             "endpoints": ["/metrics", "/statusz", "/tracez", "/healthz",
-                          "/flightz", "/profilez"],
+                          "/flightz", "/profilez", "/decisionz"],
             "uptime_s": round(time.time() - self._t0, 3),
         }))
 
@@ -295,6 +301,20 @@ class DebugServer:
         from ..trace.device import profilez_payload
 
         self._reply(h, 200, _json_bytes(profilez_payload()))
+
+    def _decisionz(self, h, q) -> None:
+        # decisionz_payload reads ONE ring snapshot and formats the
+        # latest splits' causality tables from the records' own stored
+        # outputs — no controller state is touched, nothing re-derives
+        from .replay import decisionz_payload
+
+        recent = 64
+        if q.get("n"):
+            try:
+                recent = max(1, min(4096, int(q["n"][0])))
+            except ValueError:
+                pass
+        self._reply(h, 200, _json_bytes(decisionz_payload(recent=recent)))
 
     # -- lifecycle -----------------------------------------------------------
     def close(self) -> None:

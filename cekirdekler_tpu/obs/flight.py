@@ -92,8 +92,13 @@ EVENT_KINDS = (
     "profiler-start", "profiler-stop",
 )
 
-#: Postmortem JSON schema tag — bump on incompatible changes.
-SCHEMA = "ck-postmortem-v1"
+#: Postmortem JSON schema tag.  v2 (this revision) embeds the decision
+#: ring (``obs/decisions.py``) next to the event/span rings so a crash
+#: black box answers "what was the balancer DECIDING, from which
+#: inputs" without a live rig; v1 files (no ``decisions`` key) still
+#: load — ``load_postmortem`` backfills an empty list, and every other
+#: key is unchanged (additive bump, round-trip pinned by test).
+SCHEMA = "ck-postmortem-v2"
 
 
 class FlightEvent(NamedTuple):
@@ -241,8 +246,10 @@ def dump_postmortem(
     fr = flight if flight is not None else FLIGHT
     from ..metrics.registry import REGISTRY
     from ..trace.spans import TRACER
+    from .decisions import DECISIONS
 
     spans = TRACER.snapshot()
+    decisions = DECISIONS.snapshot()
     doc = {
         "schema": SCHEMA,
         "wrote_at": time.time(),
@@ -267,6 +274,12 @@ def dump_postmortem(
             "capacity": TRACER.capacity,
             "dropped_spans": TRACER.dropped_spans,
         },
+        # v2: the decision ring — the event-sourced "what was every
+        # controller deciding, from which inputs" record, replayable
+        # offline via `python -m tools.ckreplay verify <dump>`
+        "decisions": [r.to_row() for r in decisions],
+        "decisions_total_recorded": DECISIONS.total_recorded,
+        "decisions_capacity": DECISIONS.capacity,
         "metrics": REGISTRY.snapshot(),
         "lanes": lanes,
         "versions": _versions(),
@@ -307,6 +320,10 @@ def load_postmortem(path: str) -> dict:
              r.get("tag"))
         for r in doc.get("spans", ())
     ]
+    # v1 back-compat: files written before the decision ring existed
+    # load with an explicitly-empty decision list (absence is visible
+    # as [], never a KeyError in a consumer)
+    doc["decisions"] = list(doc.get("decisions") or [])
     return doc
 
 

@@ -54,10 +54,12 @@ from dataclasses import dataclass, field
 from statistics import median
 
 from ..metrics.registry import REGISTRY
+from .decisions import DECISIONS
 
 __all__ = [
     "HealthMonitor",
     "VERDICTS",
+    "evaluate_window",
     "verdict_score",
     "score_verdict",
     "registry_health_summary",
@@ -75,6 +77,60 @@ def verdict_score(verdict: str) -> int:
 def score_verdict(score: float) -> str:
     i = max(0, min(len(VERDICTS) - 1, int(round(score))))
     return VERDICTS[i]
+
+
+def evaluate_window(
+    med: float,
+    baseline: float | None,
+    streak: int,
+    degraded: bool,
+    threshold: float,
+    confirm: int,
+    release: float,
+) -> dict:
+    """The detector's PURE per-window state transition (see the module
+    docstring for the math): one closed window's median against the
+    rolling baseline → ``{"flagged", "ratio", "streak", "degraded"}``.
+
+    Factored out of :meth:`HealthMonitor._close_window` so the decision
+    is replay-verifiable: a ``health-verdict`` record carries exactly
+    these arguments, and ``tools/ckreplay.py verify`` re-executes this
+    function and asserts the identical transition.  ``ratio`` is None
+    while the baseline is still learning AND in the zero-baseline
+    strike case (never ``float('inf')`` — the RFC-8259 rule)."""
+    flagged = False
+    ratio: float | None = None
+    if baseline is not None and baseline > 0.0:
+        ratio = med / baseline
+        if degraded:
+            # hysteresis: only a clear return to baseline releases
+            if ratio <= release:
+                degraded = False
+                streak = 0
+            else:
+                flagged = True
+        elif ratio >= threshold:
+            flagged = True
+            streak += 1
+            if streak >= confirm:
+                degraded = True
+        else:
+            streak = 0
+    elif baseline is not None and baseline == 0.0:
+        # baseline of zero: any nonzero median is "infinitely" worse —
+        # a material sample is a strike, zeros are normal
+        ratio = None if med > 0.0 else 1.0
+        if med > 0.0:
+            flagged = True
+            streak += 1
+            if streak >= confirm:
+                degraded = True
+        else:
+            streak = 0
+            degraded = False
+    # baseline None: still learning this signal's normal — no change
+    return {"flagged": flagged, "ratio": ratio, "streak": streak,
+            "degraded": degraded}
 
 
 @dataclass
@@ -129,6 +185,11 @@ class HealthMonitor:
         self._mu = threading.Lock()
         self._state: dict[tuple[int, str], _SignalState] = {}
         self._gauges: dict[int, object] = {}
+        # last advisory recorded as a decision — suggest_drain dedups
+        # on it (the health-verdict flip rule: a 1 Hz healthz/healthy()
+        # poll during a sustained degradation must not fill the
+        # decision ring with identical advisories)
+        self._last_advisory: list[int] | None = None
 
     # -- inputs --------------------------------------------------------------
     def observe(self, lane: int, signal: str, seconds: float) -> None:
@@ -141,11 +202,20 @@ class HealthMonitor:
             st = self._state.setdefault((int(lane), signal), _SignalState())
             st.window.append(v)
             if len(st.window) >= self.window:
-                self._close_window(int(lane), st)
+                self._close_window(int(lane), signal, st)
 
-    def _close_window(self, lane: int, st: _SignalState) -> None:
+    def _close_window(self, lane: int, signal: str,
+                      st: _SignalState) -> None:
         """Caller holds the lock.  Evaluate the closed window against
-        the rolling baseline and update the strike/hysteresis state."""
+        the rolling baseline (:func:`evaluate_window` — the pure,
+        replay-verifiable transition) and update the strike/hysteresis
+        state.  A verdict FLIP records a ``health-verdict`` decision
+        with the transition's complete inputs.
+
+        (``last_ratio`` stays None for the zero-baseline strike — NOT
+        ``float('inf')``: json.dumps serializes inf as the bare token
+        `Infinity`, which is RFC-8259-invalid and would break every
+        /healthz consumer and the DCN health payload.)"""
         med = median(st.window)
         st.window = []
         st.windows_closed += 1
@@ -154,47 +224,35 @@ class HealthMonitor:
             median(st.history) if len(st.history) >= self.min_history
             else None
         )
-        flagged = False
-        if baseline is not None and baseline > 0.0:
-            ratio = med / baseline
-            st.last_ratio = ratio
-            if st.degraded:
-                # hysteresis: only a clear return to baseline releases
-                if ratio <= self.release:
-                    st.degraded = False
-                    st.streak = 0
-                else:
-                    flagged = True
-            elif ratio >= self.threshold:
-                flagged = True
-                st.streak += 1
-                if st.streak >= self.confirm:
-                    st.degraded = True
-            else:
-                st.streak = 0
-        elif baseline is not None and baseline == 0.0:
-            # baseline of zero: any nonzero median is "infinitely"
-            # worse — a material sample is a strike, zeros are normal.
-            # last_ratio stays None (NOT float('inf'): json.dumps
-            # serializes inf as the bare token `Infinity`, which is
-            # RFC-8259-invalid and would break every /healthz consumer
-            # and the DCN health payload)
-            st.last_ratio = None if med > 0.0 else 1.0
-            if med > 0.0:
-                flagged = True
-                st.streak += 1
-                if st.streak >= self.confirm:
-                    st.degraded = True
-            else:
-                st.streak = 0
-                if st.degraded:
-                    st.degraded = False
-        else:
-            st.last_ratio = None  # still learning this signal's normal
-        if not flagged:
+        before = self._signal_state_name(st)
+        rec = None
+        if DECISIONS.enabled:
+            rec = {
+                "lane": lane, "signal": signal,
+                "median_s": med, "baseline_s": baseline,
+                "streak": st.streak, "degraded": st.degraded,
+                "threshold": self.threshold, "confirm": self.confirm,
+                "release": self.release,
+            }
+        res = evaluate_window(
+            med, baseline, streak=st.streak, degraded=st.degraded,
+            threshold=self.threshold, confirm=self.confirm,
+            release=self.release,
+        )
+        st.last_ratio = res["ratio"]
+        st.streak = res["streak"]
+        st.degraded = res["degraded"]
+        if not res["flagged"]:
             st.history.append(med)
             while len(st.history) > self.baseline_windows:
                 st.history.popleft()
+        after = self._signal_state_name(st)
+        if rec is not None and after != before:
+            # the FLIP is the decision of record; steady windows are
+            # recoverable from the metrics gauges and would swamp the
+            # ring at scrape cadence
+            DECISIONS.record("health-verdict", rec,
+                             dict(res, state=after, state_before=before))
         self._export_gauge_locked(lane)
 
     def _export_gauge_locked(self, lane: int) -> None:
@@ -285,11 +343,46 @@ class HealthMonitor:
     def suggest_drain(self) -> list[int]:
         """Lanes currently DEGRADED — the advisory eviction candidate
         list.  Observation only: nothing in this module (or this PR)
-        acts on it; ROADMAP item 4's elastic tier is the consumer."""
-        return [
-            lane for lane, rec in self.report().items()
+        acts on it; ROADMAP item 4's elastic tier is the consumer.
+
+        A CHANGED advisory records a ``drain-advisory`` decision
+        (inputs: every lane's verdict + per-signal ratios) so the
+        eviction work ROADMAP item 4 builds starts with provenance
+        already wired — "why was this lane named" is answerable from
+        the log alone.  Change-only, the health-verdict flip rule: a
+        polling consumer (``healthy()`` at scrape cadence) during a
+        sustained degradation must not evict the balancer/tuner
+        provenance from the ring with identical advisories; the
+        all-clear (a previously-advised list going empty) records too
+        — recovery is a decision of record."""
+        report = self.report()
+        drain = [
+            lane for lane, rec in report.items()
             if rec["verdict"] == "degraded"
         ]
+        # compare-and-set under the monitor lock (report() released it
+        # above — no nesting): the debug server's healthz thread and an
+        # application poller race this path, and an unlocked RMW could
+        # double-record a flip or overwrite the baseline the next real
+        # change must compare against
+        with self._mu:
+            changed = drain != self._last_advisory and (
+                drain or self._last_advisory)
+            self._last_advisory = drain
+        if changed and DECISIONS.enabled:
+            DECISIONS.record("drain-advisory", {
+                "lanes": {
+                    str(lane): {
+                        "verdict": rec["verdict"],
+                        "ratios": {
+                            sig: ev.get("ratio")
+                            for sig, ev in rec["evidence"].items()
+                        },
+                    }
+                    for lane, rec in report.items()
+                },
+            }, {"drain": list(drain)})
+        return drain
 
     def healthy(self) -> bool:
         """True while no lane is degraded (the ``/healthz`` 200/503

@@ -137,6 +137,20 @@ def main(pid: int, nproc: int, port: int, counts: list[int]) -> None:
             assert hm.suggest_drain() == [0], local
             assert all(local[ln]["verdict"] == "ok"
                        for ln in local if ln != 0), local
+            # the advisory left decision PROVENANCE: suggest_drain's
+            # non-empty answer is a recorded drain-advisory decision
+            # carrying every lane's verdict + ratios — ROADMAP item 4's
+            # eviction work starts with "why was this lane named"
+            # answerable from the log alone
+            from cekirdekler_tpu.obs.decisions import DECISIONS
+
+            advisories = [r for r in DECISIONS.snapshot()
+                          if r.kind == "drain-advisory"]
+            assert advisories, "degraded drain produced no decision record"
+            last = advisories[-1]
+            assert last.outputs["drain"] == [0], last.outputs
+            assert last.inputs["lanes"]["0"]["verdict"] == "degraded", \
+                last.inputs
         else:
             assert all(r["verdict"] == "ok" for r in local.values()), local
 

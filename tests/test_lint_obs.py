@@ -37,6 +37,13 @@ def test_lint_inventories_are_nonempty():
     # a regex that silently matched nothing would make the gate vacuous
     assert len(lint.code_metric_names()) >= 20
     assert len(lint.code_span_kinds()) >= 10
+    assert len(lint.code_decision_kinds()) >= 8
+
+
+def test_decision_kinds_parsed_statically_match_import():
+    from cekirdekler_tpu.obs.decisions import DECISION_KINDS
+
+    assert lint.code_decision_kinds() == set(DECISION_KINDS)
 
 
 # ---------------------------------------------------------------------------

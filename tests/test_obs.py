@@ -630,7 +630,7 @@ def test_postmortem_on_injected_driver_failure(devs, tmp_path, monkeypatch):
     dumps = glob.glob(str(tmp_path / "ck_postmortem_*.json"))
     assert len(dumps) == 1, dumps
     pm = load_postmortem(dumps[0])
-    assert pm["schema"] == "ck-postmortem-v1"
+    assert pm["schema"] == "ck-postmortem-v2"
     assert pm["exc"]["type"] == "RuntimeError"
     assert "injected driver-queue" in pm["exc"]["message"]
     # the last >= 50 flight events, with the decision history intact

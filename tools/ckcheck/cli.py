@@ -72,6 +72,7 @@ def repo_config() -> AnalyzerConfig:
         hot_lock_allow=HOT_LOCK_ALLOW,
         span_vocab=("trace.spans", "SPAN_KINDS"),
         event_vocab=("obs.flight", "EVENT_KINDS"),
+        decision_vocab=("obs.decisions", "DECISION_KINDS"),
     )
 
 
@@ -148,10 +149,11 @@ RULE_DOCS = {
         "recovers the trailing objects from that tail (the "
         "finalize_result contract)."),
     "undeclared-kind": (
-        "A span/flight event kind is emitted that is not declared in "
-        "SPAN_KINDS / EVENT_KINDS — the vocabulary tuples are the "
-        "contract lint_obs checks the documentation against; an "
-        "undeclared kind is invisible to the doc lint."),
+        "A span/flight-event/decision kind is emitted that is not "
+        "declared in SPAN_KINDS / EVENT_KINDS / DECISION_KINDS — the "
+        "vocabulary tuples are the contract lint_obs checks the "
+        "documentation against; an undeclared kind is invisible to the "
+        "doc lint."),
     "json-unsafe": (
         "json.dumps serializes float('inf')/nan as bare Infinity/NaN "
         "(invalid per RFC 8259 — the PR 6 /healthz consumer-breaking "

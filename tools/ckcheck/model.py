@@ -172,7 +172,7 @@ class RegistryCall:
 
 @dataclass
 class TelemetryCall:
-    api: str              # "span" (tracer) | "event" (flight)
+    api: str              # "span" (tracer) | "event" (flight) | "decision"
     method: str           # record | instant | span | event
     kind: str | None      # literal first arg
     line: int
@@ -762,7 +762,8 @@ class _FuncWalker:
         return (fn.attr, name)
 
     def telemetry_call(self, node: ast.Call):
-        """(api, method, literal kind) for tracer/flight record sites."""
+        """(api, method, literal kind) for tracer/flight/decision
+        record sites."""
         fn = node.func
         if not isinstance(fn, ast.Attribute):
             return None
@@ -772,6 +773,14 @@ class _FuncWalker:
             named = isinstance(fn.value, ast.Name) and fn.value.id == "TRACER"
             if named or (t and t.endswith(".Tracer")):
                 api = "span"
+            elif fn.attr == "record":
+                # the decision log shares the tracer's method name;
+                # receiver disambiguates (DECISIONS singleton / a typed
+                # DecisionLog)
+                named_d = isinstance(fn.value, ast.Name) and \
+                    fn.value.id == "DECISIONS"
+                if named_d or (t and t.endswith(".DecisionLog")):
+                    api = "decision"
         elif fn.attr == "event":
             t = self.expr_type(fn.value)
             named = isinstance(fn.value, ast.Name) and \

@@ -13,7 +13,7 @@
    allowlist, and must not compute telemetry arguments outside an
    ``.enabled`` guard (the PR 4/5/6 cached-handles review discipline).
 4. **invariant** — artifact writers keep ``headline`` last; emitted
-   span/flight kinds are declared in their vocabulary tuples;
+   span/flight/decision kinds are declared in their vocabulary tuples;
    ``json.dumps`` on export paths is Infinity/NaN-safe.
 
 Each pass returns ``list[Finding]``; suppression comments
@@ -42,9 +42,10 @@ class AnalyzerConfig:
     # locks the hot path MAY take (lock_ids)
     hot_lock_allow: tuple = ()
     # pass 4 vocabularies: (module, tuple-variable) declaring the
-    # legal span/flight kinds; None disables the corresponding rule
+    # legal span/flight/decision kinds; None disables the rule
     span_vocab: tuple | None = None     # ("trace.spans", "SPAN_KINDS")
     event_vocab: tuple | None = None    # ("obs.flight", "EVENT_KINDS")
+    decision_vocab: tuple | None = None  # ("obs.decisions", "DECISION_KINDS")
     # passes to run (all by default)
     passes: tuple = ("lock-order", "lockset", "hotpath", "invariant")
 
@@ -362,6 +363,10 @@ def pass_invariant(pkg: Package, cfg: AnalyzerConfig) -> list:
     findings: list = []
     span_kinds = _load_vocab(pkg, cfg.span_vocab)
     event_kinds = _load_vocab(pkg, cfg.event_vocab)
+    decision_kinds = _load_vocab(pkg, cfg.decision_vocab)
+    vocabs = {"span": (span_kinds, "SPAN_KINDS"),
+              "event": (event_kinds, "EVENT_KINDS"),
+              "decision": (decision_kinds, "DECISION_KINDS")}
     for q, fi in sorted(pkg.functions.items()):
         mod = pkg.modules.get(fi.module)
 
@@ -401,12 +406,11 @@ def pass_invariant(pkg: Package, cfg: AnalyzerConfig) -> list:
                 ))
 
         for tc in fi.telemetry_calls:
-            vocab = span_kinds if tc.api == "span" else event_kinds
+            vocab, what = vocabs.get(tc.api, (None, "?"))
             if vocab is None or tc.kind is None or tc.kind in vocab:
                 continue
             if mod and mod.suppressed(tc.line):
                 continue
-            what = ("SPAN_KINDS" if tc.api == "span" else "EVENT_KINDS")
             findings.append(Finding(
                 pass_id="invariant", rule="undeclared-kind",
                 path=fi.path, line=tc.line,

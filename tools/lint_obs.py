@@ -25,6 +25,11 @@ the lint can run anywhere, including rigs where jax is broken):
 - **Device-track kinds.**  The ``DEVICE_SPAN_KINDS`` tuple in
   ``trace/device.py`` must match the device-track kind table in the
   doc's device-timeline section, both directions (ISSUE 8).
+- **Decision kinds.**  The ``DECISION_KINDS`` tuple in
+  ``obs/decisions.py`` must match the decision table in the doc's
+  decision-provenance section, both directions (ISSUE 10;
+  emitted-vs-declared is ``tools/ckcheck``'s invariant pass, same
+  split as flight events).
 - **Debug endpoints.**  Every route the debug server serves
   (``obs/debugserver.py``'s routing dict, parsed by regex) must have a
   row in the doc's endpoint table, and every documented endpoint must
@@ -49,6 +54,7 @@ PKG = os.path.join(REPO, "cekirdekler_tpu")
 SPANS_PY = os.path.join(PKG, "trace", "spans.py")
 FLIGHT_PY = os.path.join(PKG, "obs", "flight.py")
 DEVICE_PY = os.path.join(PKG, "trace", "device.py")
+DECISIONS_PY = os.path.join(PKG, "obs", "decisions.py")
 DEBUGSERVER_PY = os.path.join(PKG, "obs", "debugserver.py")
 
 #: Route-table pattern in obs/debugserver.py: `"/path": self._handler`.
@@ -151,6 +157,11 @@ def code_device_kinds() -> set[str]:
     return _tuple_var(DEVICE_PY, "DEVICE_SPAN_KINDS")
 
 
+def code_decision_kinds() -> set[str]:
+    """``DECISION_KINDS`` parsed out of obs/decisions.py."""
+    return _tuple_var(DECISIONS_PY, "DECISION_KINDS")
+
+
 def code_endpoints() -> set[str]:
     """The debug server's routed paths (regex over the routing dict)."""
     out = set(_ROUTE_RE.findall(open(DEBUGSERVER_PY).read()))
@@ -193,6 +204,12 @@ def doc_device_kinds(doc_text: str) -> set[str]:
     return _doc_kind_table(
         doc_text, r"### Device-track kinds", r"\n###? ",
         "### Device-track kinds")
+
+
+def doc_decision_kinds(doc_text: str) -> set[str]:
+    return _doc_kind_table(
+        doc_text, r"### Decision provenance", r"\n###? ",
+        "### Decision provenance")
 
 
 def doc_endpoints(doc_text: str) -> set[str]:
@@ -269,6 +286,18 @@ def run() -> list[str]:
             "kind table but not in trace.device.DEVICE_SPAN_KINDS"
         )
 
+    code_dk, doc_dk = code_decision_kinds(), doc_decision_kinds(doc_text)
+    for kind in sorted(code_dk - doc_dk):
+        problems.append(
+            f"decision kind '{kind}' is in obs.decisions.DECISION_KINDS "
+            "but missing from the doc's decision-provenance table"
+        )
+    for kind in sorted(doc_dk - code_dk):
+        problems.append(
+            f"decision kind '{kind}' is in the doc's decision-provenance "
+            "table but not in obs.decisions.DECISION_KINDS"
+        )
+
     code_ep, doc_ep = code_endpoints(), doc_endpoints(doc_text)
     for ep in sorted(code_ep - doc_ep):
         problems.append(
@@ -295,6 +324,7 @@ def main(argv=None) -> int:
           f"{len(code_span_kinds())} span kinds, "
           f"{len(code_event_kinds())} flight event kinds, "
           f"{len(code_device_kinds())} device-track kinds, "
+          f"{len(code_decision_kinds())} decision kinds, "
           f"{len(code_endpoints())} debug endpoints)")
     return 0
 

@@ -27,8 +27,17 @@ drop 30% between rounds with no gate anywhere.  This tool is that gate:
   brace scanning.  Raw ``bench.py`` output lines and already-parsed
   dicts load too.
 
+- **Behavior drift is a sentinel failure too.**  Every artifact embeds
+  the decision-log replay-verify verdict (``headline.replay_ok`` —
+  bench.py re-executes the run's recorded controller decisions through
+  ``obs/replay.py`` and asserts bit-identical outputs); a candidate
+  carrying ``replay_ok: false`` hard-fails exactly like a starved key,
+  so a balancer edit that silently changes decisions becomes a named
+  failure, not a perf mystery attributed to the hardware.
+
 Exit codes: 0 = healthy, 2 = headline regression, 3 = starved/null
-watched key (both nonzero — CI gates on any nonzero).
+watched key OR replay-verify drift (both nonzero — CI gates on any
+nonzero).
 
 Usage::
 
@@ -303,9 +312,30 @@ def diff_headlines(
                 "baseline": base_v, "candidate": cand_v,
                 "drop_frac": round(drop, 4), "tolerance": round(tol, 4),
             })
-    starved = any(f["kind"] == "starved" for f in findings)
+    # decision-provenance drift: replay_ok is bench.py's in-process
+    # replay-verify verdict over the run's recorded controller
+    # decisions.  False = the decision code did not reproduce its own
+    # log — a hard failure of the same severity class as a starved key
+    # (True and absent — pre-provenance artifacts — both pass).
+    if cand_h.get("replay_ok") is False:
+        dec = None
+        sections = candidate.get("sections")
+        if isinstance(sections, dict):
+            dec = sections.get("decisions")
+        first = (dec or {}).get("replay", {}).get("first_divergence") \
+            if isinstance(dec, dict) else None
+        findings.append({
+            "kind": "replay-drift", "key": "replay_ok",
+            "reason": (
+                "the artifact's decision log did not replay "
+                "bit-identically (behavior drift in a controller); "
+                + (f"first divergence: {first}" if first else
+                   "run `python -m tools.ckreplay verify` on the run's "
+                   "CK_DECISION_LOG spill for the divergent seq")),
+        })
+    hard = any(f["kind"] in ("starved", "replay-drift") for f in findings)
     regressed = any(f["kind"] == "regression" for f in findings)
-    code = 3 if starved else (2 if regressed else 0)
+    code = 3 if hard else (2 if regressed else 0)
     return {
         "ok": code == 0, "exit_code": code, "checked": checked,
         "findings": findings,
@@ -538,6 +568,8 @@ def main(argv=None) -> int:
                 print(f"  STARVED {f['key']}: baseline had "
                       f"{f.get('baseline')}, candidate is null — "
                       f"{f['reason']}")
+            elif f["kind"] == "replay-drift":
+                print(f"  REPLAY-DRIFT {f['key']}: {f['reason']}")
             else:
                 print(f"  REGRESSION {f['key']}: {f['baseline']} -> "
                       f"{f['candidate']} (drop {f['drop_frac']:.1%} > "
