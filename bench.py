@@ -662,7 +662,12 @@ class SectionScheduler:
 # fit well inside that); on a bad day the gates win, which is the
 # explicit priority ordering the r5 verdict asked for.
 RESERVED_SECTIONS = {"flash_train": 360.0, "marker_overhead": 60.0,
-                     "dtype_matrix": 430.0, "dispatch_floor": 90.0}
+                     "dtype_matrix": 430.0, "dispatch_floor": 90.0,
+                     # the serving tier's loadgen (ISSUE 11): the four
+                     # serve_* headline keys are regression-watched from
+                     # round one — a gate metric without a reservation
+                     # starves (the r4/r5 lesson)
+                     "serving": 60.0}
 
 #: Must-run slice granted to a fairness-rotation promotion (a section
 #: budget-starved 2 rounds running) — big enough for every current
@@ -671,6 +676,24 @@ FAIRNESS_SLICE_SEC = 120.0
 
 
 _REGRESS_MOD = None
+_LOADGEN_MOD = None
+
+
+def _load_loadgen():
+    """Exec tools/loadgen.py as a module (the _load_regress pattern:
+    tools/ is not a package, the bench loads its neighbors by path)."""
+    global _LOADGEN_MOD
+    if _LOADGEN_MOD is not None:
+        return _LOADGEN_MOD
+    import importlib.util
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    spec = importlib.util.spec_from_file_location(
+        "ck_loadgen", os.path.join(here, "tools", "loadgen.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    _LOADGEN_MOD = mod
+    return mod
 
 
 def _load_regress():
@@ -1026,6 +1049,17 @@ def main() -> None:
 
     dfloor = section("dispatch_floor", lambda: dispatch_floor_sweep())
 
+    # Serving tier (ISSUE 11): 32 concurrent clients through the
+    # multi-tenant front-end (serve/), mixed signatures coalescing into
+    # fused-window ladder launches — closed-loop p50/p99 latency +
+    # open-loop goodput + the requests-vs-launches coalescing evidence,
+    # bit-exactness checked (docs/SERVING.md; tools/loadgen.py is the
+    # standalone CLI).  Every admission/coalesce decision lands in the
+    # decision ring, so finalize_result's replay-verify covers the
+    # serving controllers too.
+    serving = section(
+        "serving", lambda: _load_loadgen().loadgen_section(devs))
+
     # Balancer on the 8-device rig with skewed per-range load (r2 #4).
     rig = section("balancer_rig", balancer_rig_section)
 
@@ -1107,6 +1141,7 @@ def main() -> None:
         "nbody_checked": bool(nb["checked"]),
         "nbody_e2e": nbe,
         "dispatch_floor": dfloor,
+        "serving": serving,
         "nbody_note": (
             "nbody_gpairs_per_sec = sync-per-call variant (host fence "
             "every iteration, RTT-bound — a dispatch-latency metric); "
@@ -1191,6 +1226,24 @@ def main() -> None:
             "dispatch_floor_collapse": (
                 dfloor.get("floor_collapse_at_kmax")
                 if isinstance(dfloor, dict) else None
+            ),
+            # the serving tier's loadgen keys (ISSUE 11): closed-loop
+            # latency percentiles, open-loop goodput, and the
+            # requests-per-ladder-launch coalescing ratio (> 1 = N
+            # clients' requests collapsed into fewer dispatches)
+            "serve_p50_ms": (
+                serving.get("p50_ms") if isinstance(serving, dict) else None
+            ),
+            "serve_p99_ms": (
+                serving.get("p99_ms") if isinstance(serving, dict) else None
+            ),
+            "serve_goodput_rps": (
+                serving.get("goodput_rps")
+                if isinstance(serving, dict) else None
+            ),
+            "serve_coalesce_ratio": (
+                serving.get("coalesce_ratio")
+                if isinstance(serving, dict) else None
             ),
             "dtype_cells": (
                 f"{dtypes.get('cells_pass')}p/{dtypes.get('cells_veto')}v/"

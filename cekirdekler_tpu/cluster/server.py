@@ -161,10 +161,21 @@ class _ClientSession(threading.Thread):
 
 
 class CruncherServer:
-    """TCP compute node (reference: ClCruncherServer.cs:56-133)."""
+    """TCP compute node (reference: ClCruncherServer.cs:56-133).
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0, devices=None):
+    Concurrent-client contract: each accepted connection runs its OWN
+    session thread with its own cruncher and array cache — a second
+    client's SETUP/COMPUTE proceeds while the first session is
+    mid-compute (nothing serializes sessions against each other;
+    pinned by ``tests/test_cluster.py``).  ``max_sessions`` bounds the
+    concurrency: a connection beyond it is REJECTED with a named
+    ``ANSWER_ERROR`` and closed — the client's next round trip raises
+    instead of hanging on a connection the server will never serve."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0, devices=None,
+                 max_sessions: int = 32):
         self.devices = devices if devices is not None else all_devices()
+        self.max_sessions = max(1, int(max_sessions))
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
@@ -184,9 +195,44 @@ class CruncherServer:
             except OSError:
                 break
             self._sessions = [s for s in self._sessions if s.is_alive()]
+            if len(self._sessions) >= self.max_sessions:
+                # reject-with-a-name, never a silent hang: the client's
+                # first round trip reads this error instead of waiting
+                # on a session thread that will never exist (the
+                # serving tier's admission contract, applied here).  A
+                # tiny daemon reads the client's first command BEFORE
+                # replying — an unsolicited error followed by close can
+                # be RST-discarded when the client's request lands on
+                # the already-closed socket
+                threading.Thread(
+                    target=self._reject_session, args=(conn,),
+                    daemon=True, name="cruncher-reject",
+                ).start()
+                continue
             session = _ClientSession(self, conn, addr)
             self._sessions.append(session)
             session.start()
+
+    def _reject_session(self, conn: socket.socket) -> None:
+        """Answer one over-capacity connection's first command with a
+        named error, then close (request → error reply ordering, so
+        the rejection survives the TCP teardown)."""
+        try:
+            conn.settimeout(5.0)
+            recv_message(conn)
+            send_message(conn, Message(
+                Command.ANSWER_ERROR,
+                strings=[
+                    f"server at capacity ({self.max_sessions} "
+                    "concurrent sessions); retry later"],
+            ))
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
 
     def stop(self) -> None:
         self._running = False

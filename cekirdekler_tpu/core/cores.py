@@ -55,7 +55,33 @@ from .balance import (
 from .stream import TransferTuner, chunk_plan
 from .worker import Worker
 
-__all__ = ["Cores", "PIPELINE_EVENT", "PIPELINE_DRIVER", "ComputePerf"]
+__all__ = ["Cores", "PIPELINE_EVENT", "PIPELINE_DRIVER", "ComputePerf",
+           "job_signature"]
+
+
+def job_signature(
+    kernel_names, params, compute_id, global_range, local_range,
+    global_offset, value_args,
+) -> tuple:
+    """Identity of one repeatable enqueue call — THE coalescing key.
+    One function on purpose: the fused-window machinery
+    (``Cores._fused_signature``) and the serving tier's request
+    grouping (``serve.frontend.ServeJob.signature``) must build the
+    identical tuple, else batches silently stop matching open windows
+    and every dispatch rides the per-call fallback.  Params enter by
+    OBJECT identity: the workers' buffer caches key on ``id(arr)``, so
+    a different array object is a different dispatch even at equal
+    shapes."""
+    if isinstance(value_args, dict):
+        vals: Any = tuple(
+            (k, tuple(v)) for k, v in sorted(value_args.items())
+        )
+    else:
+        vals = tuple(value_args)
+    return (
+        compute_id, tuple(kernel_names), tuple(id(p) for p in params),
+        global_range, local_range, global_offset, vals,
+    )
 
 PIPELINE_EVENT = 1   # reference: Cores.cs:416-423
 PIPELINE_DRIVER = 2
@@ -746,19 +772,13 @@ class Cores:
         self, kernel_names, params, compute_id, global_range,
         local_range, global_offset, value_args,
     ) -> tuple:
-        """Identity of one repeatable enqueue call.  Params enter by
-        OBJECT identity: the workers' buffer caches key on id(arr), so a
-        different array object is a different dispatch even at equal
-        shapes."""
-        if isinstance(value_args, dict):
-            vals: Any = tuple(
-                (k, tuple(v)) for k, v in sorted(value_args.items())
-            )
-        else:
-            vals = tuple(value_args)
-        return (
-            compute_id, tuple(kernel_names), tuple(id(p) for p in params),
-            global_range, local_range, global_offset, vals,
+        """Identity of one repeatable enqueue call — delegates to the
+        shared :func:`job_signature` (the serving tier builds the same
+        tuple to group requests; one construction keeps them from
+        drifting apart)."""
+        return job_signature(
+            kernel_names, params, compute_id, global_range, local_range,
+            global_offset, value_args,
         )
 
     def _fused_try_engage(
@@ -978,6 +998,115 @@ class Cores:
         cid = run.compute_id if run is not None else None
         self._fused_close()
         self._note_disengage(reason, cid)
+
+    # -- externally-assembled batches (the serving tier's entry) -------------
+    def _batch_defer(self, sig: tuple, k: int, t_start: float) -> bool:
+        """Count ``k`` iterations into the open fused window matching
+        ``sig`` in ONE step — the externally-assembled batch's deferral
+        (``compute_fused_batch``) — then flush, so the whole batch
+        lands as ONE ladder dispatch per device.  Returns False when no
+        healthy matching window is open (the caller falls back to the
+        per-call path); the guard re-checks exactly what the per-call
+        deferral re-checks: runtime mode toggles, an armed rebalance,
+        and the coverage epoch (a mid-batch reset means operands are no
+        longer guaranteed HBM-resident)."""
+        with self._lock:
+            run = self._fused_run
+            if (
+                run is None
+                or not self._sig_equal(self._fused_sig, sig)
+                or not self.fused_dispatch
+                or self.no_compute_mode
+                or self.repeat_count > 1
+                or self.repeat_sync_kernel
+                or self.dispatch_gate is not None
+                or self.trace_lanes
+                or run.compute_id in self._enqueue_rebalance
+                or any(w.coverage_epoch != ep for w, ep in run.epochs)
+            ):
+                return False
+            cid = run.compute_id
+            # ONE order-list touch + bulk iteration-count bumps: k
+            # repeated _note_enqueue_call calls would pay k redundant
+            # remove/append cycles on the cid order list while holding
+            # the scheduler lock against every concurrent deferral
+            self._note_enqueue_call(cid, t_start)
+            if k > 1:
+                self._enqueue_iters[cid] += k - 1
+                self._flush_iters[cid] += k - 1
+            self._fused_pending += k
+            self.fused_stats["deferred_iters"] += k
+        self._m_fused_deferred.inc(k)
+        self._fused_flush()
+        return True
+
+    def compute_fused_batch(
+        self,
+        kernel_names: Sequence[str],
+        params: Sequence[ClArray],
+        compute_id: int,
+        global_range: int,
+        local_range: int,
+        iters: int,
+        global_offset: int = 0,
+        value_args: Sequence | dict = (),
+    ) -> dict:
+        """Dispatch an EXTERNALLY-ASSEMBLED batch of ``iters`` identical
+        enqueue iterations — the serving tier's coalesced-dispatch entry
+        (``serve/frontend.py``): a front-end that already holds K
+        same-signature requests must not pay K per-call dispatches to
+        get them fused.
+
+        The first iteration(s) ride the per-call :meth:`compute` path
+        (uploads, range table, window bookkeeping, organic fused-window
+        engagement — at most two calls when the signature is fusable,
+        one when the window's candidate already matches from a previous
+        batch); once a matching window is open, the REMAINDER counts in
+        as one batch deferral and flushes immediately: ONE
+        dynamic-iteration-count ladder dispatch per device for the whole
+        residue, bit-identical to ``iters`` per-call computes (the
+        per-call fallback below preserves that equivalence when fusion
+        cannot apply — mode toggles, non-resident operands, unhashable
+        values — so callers never need their own fallback).
+
+        Requires :attr:`enqueue_mode` (the batch contract is deferred
+        readbacks; results land at the caller's ``barrier``/``flush``).
+        Returns ``{"iters", "fused", "ladder_iters", "per_call_iters"}``
+        — observability for the coalesce-ratio accounting (the ladder
+        iterations also count into ``fused_stats`` / ``ck_fused_*``
+        like any fused window)."""
+        iters = int(iters)
+        if iters < 1:
+            raise ComputeValidationError(
+                f"compute_fused_batch needs iters >= 1, got {iters}")
+        if not self.enqueue_mode:
+            raise ComputeValidationError(
+                "compute_fused_batch requires enqueue_mode (deferred "
+                "readbacks are the batch contract)")
+        sig = self._fused_signature(
+            kernel_names, params, compute_id, global_range, local_range,
+            global_offset, value_args,
+        )
+        done = 0
+        ladder = 0
+        while done < iters:
+            t_start = time.perf_counter()
+            if self._batch_defer(sig, iters - done, t_start):
+                ladder = iters - done
+                done = iters
+                break
+            self.compute(
+                kernel_names, params, compute_id, global_range,
+                local_range, global_offset=global_offset,
+                value_args=value_args,
+            )
+            done += 1
+        return {
+            "iters": iters,
+            "fused": ladder > 0,
+            "ladder_iters": ladder,
+            "per_call_iters": iters - ladder,
+        }
 
     def _fused_drain(self) -> None:
         errs: list[Exception] = []

@@ -34,6 +34,9 @@ Endpoints (all GET, all JSON unless noted):
   the latest split's per-lane causality table per compute id (the live
   ``explain``; ``tools/ckreplay.py explain`` renders the same thing
   from a spilled log).
+- ``/servez`` — the serving tier (``serve/frontend.py``): every live
+  frontend's queue depth, signature-group table (pending + starvation
+  streaks), per-tenant accounting, and admission configuration.
 
 Lock discipline (the hot-path contract): every endpoint reads
 SNAPSHOTS — ``REGISTRY.snapshot()`` copies under the registry lock,
@@ -148,6 +151,7 @@ class DebugServer:
             "/flightz": self._flightz,
             "/profilez": self._profilez,
             "/decisionz": self._decisionz,
+            "/servez": self._servez,
         }.get(url.path)
         if route is None:
             self._reply(h, 404, _json_bytes(
@@ -168,7 +172,7 @@ class DebugServer:
     def _index(self, h, q) -> None:
         self._reply(h, 200, _json_bytes({
             "endpoints": ["/metrics", "/statusz", "/tracez", "/healthz",
-                          "/flightz", "/profilez", "/decisionz"],
+                          "/flightz", "/profilez", "/decisionz", "/servez"],
             "uptime_s": round(time.time() - self._t0, 3),
         }))
 
@@ -315,6 +319,14 @@ class DebugServer:
             except ValueError:
                 pass
         self._reply(h, 200, _json_bytes(decisionz_payload(recent=recent)))
+
+    def _servez(self, h, q) -> None:
+        # servez_payload copies each frontend's small state under its
+        # own lock (stats()) — the same snapshot discipline as every
+        # other endpoint; no submit is blocked for longer than the copy
+        from ..serve.frontend import servez_payload
+
+        self._reply(h, 200, _json_bytes(servez_payload()))
 
     # -- lifecycle -----------------------------------------------------------
     def close(self) -> None:

@@ -103,6 +103,8 @@ DECISION_KINDS = (
     "health-verdict",      # obs/health — a (lane, signal) verdict flipped
     "drain-advisory",      # obs/health.suggest_drain — lanes named for eviction
     "scheduler-rotation",  # bench.SectionScheduler — fairness promotion
+    "admission",           # serve/admission — one request admitted/rejected
+    "coalesce",            # serve/coalescer — one dispatch cycle's batch plan
 )
 
 #: The subset replay-verify re-executes: decisions that are pure
@@ -111,6 +113,7 @@ DECISION_KINDS = (
 #: are derived views) are context records — provenance, not oracles.
 REPLAYABLE_KINDS = (
     "load-balance", "transfer-choose", "transfer-observe", "health-verdict",
+    "admission", "coalesce",
 )
 
 #: Spill-buffer bound: the armed jsonl accumulation is capped so a

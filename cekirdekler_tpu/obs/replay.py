@@ -296,11 +296,46 @@ def _replay_health_verdict(inp: dict, out: dict) -> dict:
     return mism
 
 
+def _replay_admission(inp: dict, out: dict) -> dict:
+    from ..serve.admission import admit_decision
+
+    got = admit_decision(
+        tenant_inflight=int(inp["tenant_inflight"]),
+        quota=int(inp["quota"]),
+        queue_depth=int(inp["queue_depth"]),
+        max_queue_depth=int(inp["max_queue_depth"]),
+        healthy=bool(inp["healthy"]),
+        est_batch_s=float(inp["est_batch_s"]),
+    )
+    mism: dict = {}
+    for k in ("admit", "reason", "retry_after_s"):
+        if got.get(k) != out.get(k):
+            mism[k] = {"expected": out.get(k), "got": got.get(k)}
+    return mism
+
+
+def _replay_coalesce(inp: dict, out: dict) -> dict:
+    from ..serve.coalescer import plan_coalesce
+
+    got = plan_coalesce(
+        list(inp.get("groups") or ()), int(inp.get("round", 0)),
+        int(inp.get("max_picks") or 0),
+    )
+    mism: dict = {}
+    for k in ("order", "picked", "promoted"):
+        gv, ev = list(got.get(k) or ()), list(out.get(k) or ())
+        if gv != ev:
+            mism[k] = {"expected": ev, "got": gv}
+    return mism
+
+
 _REPLAYERS = {
     "load-balance": _replay_load_balance,
     "transfer-choose": _replay_transfer_choose,
     "transfer-observe": _replay_transfer_observe,
     "health-verdict": _replay_health_verdict,
+    "admission": _replay_admission,
+    "coalesce": _replay_coalesce,
 }
 assert set(_REPLAYERS) == set(REPLAYABLE_KINDS)
 

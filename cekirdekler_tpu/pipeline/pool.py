@@ -30,7 +30,7 @@ import itertools
 import queue
 import threading
 import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass, field, replace
 from typing import Any, Callable, Sequence
 
 from ..arrays.clarray import ClArray, ParameterGroup
@@ -80,6 +80,10 @@ class ClTask:
     task_type: ClTaskType = ClTaskType.COMPUTE
     select_device: int | None = None       # DEVICE_SELECT_BEGIN argument
     callback: Callable[["ClTask"], None] | None = None
+    # tenant tag: the serving tier's per-tenant label (serve/), carried
+    # so pool tasks attribute to the same tenant series (None = the
+    # untagged pre-serving behavior, metrics unchanged)
+    tenant: str | None = None
     task_id: int = field(default_factory=lambda: next(_task_ids))
 
     def compute(self, cruncher: NumberCruncher) -> None:
@@ -135,9 +139,15 @@ class ClTaskPool:
             self._tasks.append(task)
         return self
 
-    def feed(self, other: "ClTaskPool") -> None:
+    def feed(self, other: "ClTaskPool", tenant: str | None = None) -> None:
         """Append copies of another pool's tasks (reference: feed,
         ClPipeline.cs:3660-3670).
+
+        ``tenant`` tags the fed tasks with the serving tier's per-tenant
+        label (``ClTask.tenant``) so pool work attributes to the same
+        ``tenant=...`` metric series the front-end uses; tasks already
+        carrying their own tag keep it, and untagged feeds (the default)
+        change nothing.
 
         ``other.snapshot()`` is taken BEFORE acquiring our lock: holding
         it across the call nests two ClTaskPool locks, so concurrent
@@ -145,6 +155,11 @@ class ClTaskPool:
         the ABBA deadlock ckcheck's lock-order pass flags (and
         ``a.feed(a)`` would self-deadlock on the non-reentrant lock)."""
         tasks = other.snapshot()
+        if tenant is not None:
+            tasks = [
+                t if t.tenant is not None else replace(t, tenant=str(tenant))
+                for t in tasks
+            ]
         with self._lock:
             self._tasks.extend(tasks)
 
@@ -218,12 +233,25 @@ class _Consumer(threading.Thread):
                     task.compute(self.cruncher)
                     TRACER.record(
                         "pool-task", _tt, cid=task.compute_id,
-                        lane=self.index, tag=f"task{task.task_id}",
-                    )
-                    REGISTRY.counter(
-                        "ck_pool_tasks_total", "device-pool tasks completed",
                         lane=self.index,
-                    ).inc()
+                        tag=(f"task{task.task_id}" if task.tenant is None
+                             else f"task{task.task_id}@{task.tenant}"),
+                    )
+                    # tenant-tagged tasks attribute to the serving
+                    # tier's per-tenant series; untagged tasks keep the
+                    # exact pre-serving series (no label-set change)
+                    if task.tenant is not None:
+                        REGISTRY.counter(
+                            "ck_pool_tasks_total",
+                            "device-pool tasks completed",
+                            lane=self.index, tenant=task.tenant,
+                        ).inc()
+                    else:
+                        REGISTRY.counter(
+                            "ck_pool_tasks_total",
+                            "device-pool tasks completed",
+                            lane=self.index,
+                        ).inc()
                     self.tasks_done += 1
                     if task.callback is not None:
                         task.callback(task)

@@ -1,0 +1,37 @@
+"""Serving tier: the multi-tenant request front-end (docs/SERVING.md).
+
+The reference's only multi-caller story is the greedy task-pool/device-
+pool tier (``pipeline/pool.py``) plus a prealpha single-session TCP
+server — neither admits many concurrent clients against ONE shared
+scheduler.  This package is the entry point the ROADMAP's "millions of
+users" north star needs: N concurrent clients submit kernel jobs
+through :class:`ServeFrontend.submit`, an admission layer enforces
+per-tenant quotas and queue-depth backpressure (reject-with-retry-after,
+never a silent drop) and consults the lane-health verdicts, and a
+coalescing scheduler groups same-signature requests into batches that
+dispatch as fused windows — the shape-only executable cache makes a
+coalesced batch ONE ladder launch, so request coalescing IS batching.
+"""
+
+from .admission import (
+    AdmissionController,
+    ServeRejected,
+    TenantQuota,
+    admit_decision,
+)
+from .coalescer import STARVE_ROUNDS, plan_coalesce
+from .frontend import ServeFrontend, ServeJob, servez_payload
+from .tenants import TenantTable
+
+__all__ = [
+    "AdmissionController",
+    "ServeFrontend",
+    "ServeJob",
+    "ServeRejected",
+    "TenantQuota",
+    "TenantTable",
+    "STARVE_ROUNDS",
+    "admit_decision",
+    "plan_coalesce",
+    "servez_payload",
+]

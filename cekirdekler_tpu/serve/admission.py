@@ -1,0 +1,203 @@
+"""Admission control: quotas, backpressure, and the health gate.
+
+The serving tier's first decision about every request is made HERE,
+before any queue is touched: is the tier healthy enough to take work,
+is there room in the global queue, and is this tenant inside its
+concurrency quota?  A refused request is **rejected with a retry-after
+hint** (:class:`ServeRejected`), never silently dropped — the client
+always learns what happened and when trying again is reasonable.
+
+The decision itself is the PURE function :func:`admit_decision`:
+every input it reads is snapshotted into an ``admission`` decision
+record (``obs/decisions.py``), so ``ckreplay verify`` re-executes it
+bit-identically offline — a tenant disputing a rejection is answered
+from the log, not from a live rig (the tenant-starvation-dispute
+story ROADMAP item 1 names).
+
+Check order (the contract, pinned by test):
+
+1. **health** — the lane-health verdict gates the whole tier: with any
+   lane degraded (``HealthMonitor.healthy()`` false — the same verdict
+   ``/healthz`` serves as 503) nothing is admitted; retry-after backs
+   off hardest.
+2. **queue depth** — the global pending-request bound; the tier sheds
+   load before its latency collapses (backpressure, not buffering).
+3. **tenant quota** — per-tenant in-flight concurrency cap; one noisy
+   tenant cannot starve the rest.
+
+``retry_after_s`` is a deterministic function of the same inputs
+(scaled by the frontend's recent batch wall estimate), so replay
+verifies it too.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+
+from ..errors import CekirdeklerError
+from ..obs.decisions import DECISIONS
+
+__all__ = [
+    "AdmissionController",
+    "ServeRejected",
+    "TenantQuota",
+    "admit_decision",
+    "REJECT_HEALTH",
+    "REJECT_QUEUE",
+    "REJECT_QUOTA",
+]
+
+#: Named rejection reasons (the ``ck_serve_rejected_total{reason}``
+#: label vocabulary and the ``ServeRejected.reason`` values).
+REJECT_HEALTH = "unhealthy"
+REJECT_QUEUE = "queue-depth"
+REJECT_QUOTA = "tenant-quota"
+
+#: Floor for retry-after hints: even an instant-drain tier should not
+#: invite a reject/retry busy-loop.
+_RETRY_FLOOR_S = 0.005
+
+
+class ServeRejected(CekirdeklerError):
+    """A submit refused by admission — carries the named ``reason``
+    (:data:`REJECT_HEALTH` / :data:`REJECT_QUEUE` / :data:`REJECT_QUOTA`)
+    and the ``retry_after_s`` hint.  Raised, never silently dropped:
+    the client always learns why and when to come back."""
+
+    def __init__(self, tenant: str, reason: str, retry_after_s: float):
+        self.tenant = tenant
+        self.reason = reason
+        self.retry_after_s = retry_after_s
+        super().__init__(
+            f"request from tenant {tenant!r} rejected ({reason}); "
+            f"retry after {retry_after_s:.3f}s"
+        )
+
+
+@dataclass(frozen=True)
+class TenantQuota:
+    """Per-tenant admission limits.  ``max_inflight`` bounds the
+    tenant's admitted-but-not-completed requests (queued + dispatched)."""
+
+    max_inflight: int = 64
+
+
+def admit_decision(
+    tenant_inflight: int,
+    quota: int,
+    queue_depth: int,
+    max_queue_depth: int,
+    healthy: bool,
+    est_batch_s: float,
+) -> dict:
+    """The PURE admission transition (replay-verified — see module
+    docstring for the check order).  Returns ``{"admit", "reason",
+    "retry_after_s"}``; ``reason``/``retry_after_s`` are None on
+    admit."""
+    base = max(float(est_batch_s), _RETRY_FLOOR_S)
+    if not healthy:
+        # tier-wide gate: back off hardest — a degraded lane needs
+        # windows, not more traffic
+        return {"admit": False, "reason": REJECT_HEALTH,
+                "retry_after_s": base * 4.0}
+    if queue_depth >= max_queue_depth:
+        # the deeper past the bound the caller found the queue, the
+        # longer the honest drain estimate
+        overflow = queue_depth - max_queue_depth + 1
+        frac = overflow / max(max_queue_depth, 1)
+        return {"admit": False, "reason": REJECT_QUEUE,
+                "retry_after_s": base * (1.0 + frac)}
+    if tenant_inflight >= quota:
+        # one batch cycle typically retires quota-bounded work
+        return {"admit": False, "reason": REJECT_QUOTA,
+                "retry_after_s": base}
+    return {"admit": True, "reason": None, "retry_after_s": None}
+
+
+class AdmissionController:
+    """Quota table + queue bound + health gate over
+    :func:`admit_decision`.
+
+    Thread-safe; :meth:`check` is on the submit hot path, so the health
+    verdict is TTL-cached (``health_ttl_s``) — the monitor lock is not
+    taken per request — and the decision record is built only behind
+    ``DECISIONS.enabled``."""
+
+    def __init__(
+        self,
+        max_queue_depth: int = 1024,
+        default_quota: TenantQuota | int | None = None,
+        health=None,
+        health_ttl_s: float = 0.05,
+    ):
+        if isinstance(default_quota, int):
+            default_quota = TenantQuota(max_inflight=default_quota)
+        self.default_quota = default_quota or TenantQuota()
+        self.max_queue_depth = max(1, int(max_queue_depth))
+        self._health = health  # callable -> bool; None = always healthy
+        self.health_ttl_s = float(health_ttl_s)
+        self._mu = threading.Lock()
+        self._quotas: dict[str, TenantQuota] = {}
+        self._health_cache: tuple[float, bool] = (-1e18, True)
+
+    # -- configuration -------------------------------------------------------
+    def set_quota(self, tenant: str, quota: TenantQuota | int) -> None:
+        if isinstance(quota, int):
+            quota = TenantQuota(max_inflight=quota)
+        with self._mu:
+            self._quotas[str(tenant)] = quota
+
+    def quota_of(self, tenant: str) -> TenantQuota:
+        with self._mu:
+            return self._quotas.get(str(tenant), self.default_quota)
+
+    # -- the gate ------------------------------------------------------------
+    def healthy(self, now: float | None = None) -> bool:
+        """The TTL-cached tier health verdict (True with no gate
+        wired)."""
+        if self._health is None:
+            return True
+        t = time.perf_counter() if now is None else now
+        with self._mu:
+            t_cached, v = self._health_cache
+            if t - t_cached < self.health_ttl_s:
+                return v
+        v = bool(self._health())
+        with self._mu:
+            self._health_cache = (t, v)
+        return v
+
+    def check(
+        self,
+        tenant: str,
+        tenant_inflight: int,
+        queue_depth: int,
+        est_batch_s: float,
+    ) -> dict:
+        """One admission decision for ``tenant``, recorded with its
+        complete inputs (kind ``admission``).  Returns the
+        :func:`admit_decision` dict; the caller raises
+        :class:`ServeRejected` / increments its own accounting."""
+        quota = self.quota_of(tenant).max_inflight
+        healthy = self.healthy()
+        dec = admit_decision(
+            tenant_inflight=int(tenant_inflight), quota=int(quota),
+            queue_depth=int(queue_depth),
+            max_queue_depth=self.max_queue_depth,
+            healthy=healthy, est_batch_s=float(est_batch_s),
+        )
+        if DECISIONS.enabled:
+            # the complete replay inputs — a rejected tenant's dispute
+            # is answerable from this record alone
+            DECISIONS.record("admission", {
+                "tenant": str(tenant),
+                "tenant_inflight": int(tenant_inflight),
+                "quota": int(quota),
+                "queue_depth": int(queue_depth),
+                "max_queue_depth": self.max_queue_depth,
+                "healthy": healthy,
+                "est_batch_s": float(est_batch_s),
+            }, dict(dec))
+        return dec

@@ -1,0 +1,87 @@
+"""Coalescing plan: which signature groups dispatch this cycle, in
+what order.
+
+Requests coalesce by **job signature** (kernels + param identity +
+ranges + values — the same identity the fused-dispatch window keys on,
+``Cores._fused_signature``): a group of same-signature requests
+dispatches as ONE fused ladder per device
+(``Cores.compute_fused_batch``), so the coalescing plan is literally
+the batching plan.
+
+:func:`plan_coalesce` is a PURE function of its snapshot — every call
+is recorded as a ``coalesce`` decision and re-executed bit-identically
+by ``ckreplay verify``.  Ordering rules, pinned by test:
+
+1. **Fairness promotions first.**  A group that lost the pick
+   :data:`STARVE_ROUNDS` (2) consecutive planning rounds is promoted to
+   the FRONT of the order — the SectionScheduler starvation rotation
+   (bench.py, r10) generalized from bench sections to request groups:
+   no group can starve more than 2 consecutive rounds, and the
+   promotion order rotates deterministically with the round count (the
+   same anchor arithmetic) so a multi-member streak shares the head
+   slot instead of re-starving its tail member.
+2. **Deadline-aware (EDF) next.**  Among unpromoted groups, the
+   earliest deadline dispatches first; groups with no deadline sort
+   after every deadlined group.
+3. **Oldest arrival breaks ties**, then the group key (total
+   determinism — the same snapshot always yields the same plan).
+
+``max_picks`` bounds how many groups one cycle dispatches (0 = all);
+starvation only arises under that bound, which is exactly when the
+fairness rule matters.
+"""
+
+from __future__ import annotations
+
+__all__ = ["plan_coalesce", "STARVE_ROUNDS"]
+
+#: Consecutive lost rounds that promote a group to the front of the
+#: plan (the SectionScheduler's "no section starves more than 2
+#: consecutive rounds" guarantee, applied to request groups).
+STARVE_ROUNDS = 2
+
+
+def _edf_key(g: dict):
+    dl = g.get("deadline_in_s")
+    return (
+        0 if dl is not None else 1,          # deadlined groups first
+        float(dl) if dl is not None else 0.0,  # earliest deadline
+        -float(g.get("oldest_age_s") or 0.0),  # then oldest arrival
+        str(g.get("key")),                     # total determinism
+    )
+
+
+def plan_coalesce(groups: list, round_idx: int, max_picks: int = 0) -> dict:
+    """The PURE coalescing plan (see module docstring).
+
+    ``groups`` rows are ``{"key", "pending", "deadline_in_s",
+    "oldest_age_s", "starved_rounds"}`` snapshots; ``round_idx`` is the
+    dispatcher's monotone planning-round counter (the rotation anchor);
+    ``max_picks`` bounds the cycle (0/negative = unbounded).
+
+    Returns ``{"order": [keys], "picked": [keys], "promoted": [keys],
+    "max_picks": n}`` — ``picked`` is the prefix this cycle dispatches;
+    ``order`` is the full ranking (the starvation bookkeeping's
+    reference)."""
+    rows = [g for g in groups if int(g.get("pending", 0)) > 0]
+    streak = sorted(
+        (str(g["key"]) for g in rows
+         if int(g.get("starved_rounds", 0)) >= STARVE_ROUNDS),
+    )
+    promoted: list[str] = []
+    if streak:
+        anchor = int(round_idx) % len(streak)
+        promoted = streak[anchor:] + streak[:anchor]
+    rest = sorted(
+        (g for g in rows if str(g["key"]) not in set(promoted)),
+        key=_edf_key,
+    )
+    order = promoted + [str(g["key"]) for g in rest]
+    n = int(max_picks)
+    picked = order[:n] if n > 0 else list(order)
+    return {
+        "order": order,
+        "picked": picked,
+        "promoted": promoted,
+        "max_picks": n if n > 0 else 0,
+    }

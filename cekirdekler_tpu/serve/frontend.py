@@ -1,0 +1,464 @@
+"""ServeFrontend: N concurrent clients, one shared scheduler.
+
+The thread-safe, in-process multi-tenant front-end over one shared
+:class:`~cekirdekler_tpu.core.cores.Cores`: clients call
+:meth:`ServeFrontend.submit` (futures-based; :meth:`ServeFrontend.call`
+is the blocking convenience) from any thread; admission
+(``serve/admission.py``) enforces per-tenant quotas, queue-depth
+backpressure, and the lane-health gate; and ONE dispatcher thread
+drains the queues — the enqueue-window machinery is single-driver by
+contract (core/cores.py KNOWN LIMIT), so the frontend IS that single
+driver and every client rides it.
+
+**Request coalescing is batching.**  Pending requests group by job
+signature (kernels + param identity + ranges + values — the fused
+window's own key); each dispatch cycle plans an order over the groups
+(``serve/coalescer.py``: fairness promotions, then earliest deadline,
+then oldest arrival) and dispatches each picked group as ONE fused
+ladder per device via ``Cores.compute_fused_batch`` — a coalesced
+batch of K same-signature requests costs one per-call iteration plus
+one K−1-iteration ladder launch, not K dispatches, because the
+shape-only executable cache makes every batch a compile hit.  The
+cycle closes with one ``barrier()`` (balancer feedback) + ``flush()``
+(host results), and every request's future resolves with its measured
+latency.
+
+Every admission decision and every coalescing plan lands in the
+decision log (kinds ``admission`` / ``coalesce``) with complete
+inputs, so ``ckreplay verify`` re-derives them offline — a tenant
+disputing a rejection or a starvation is answered from the log.
+
+``/servez`` (obs/debugserver.py) serves :func:`servez_payload`: every
+live frontend's queue depths, group table, tenant accounting, and
+admission configuration.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import weakref
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+from typing import Sequence
+
+from ..errors import CekirdeklerError, ComputeValidationError
+from ..metrics.registry import REGISTRY
+from ..obs.decisions import DECISIONS
+from .admission import AdmissionController, ServeRejected
+from .coalescer import plan_coalesce
+from .tenants import TenantTable
+
+__all__ = ["ServeFrontend", "ServeJob", "servez_payload"]
+
+#: Requests-per-batch histogram buckets (count-flavored, not the
+#: seconds-flavored defaults).
+_BATCH_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512)
+
+
+@dataclass(frozen=True)
+class ServeJob:
+    """A frozen, resubmittable kernel job (the serving tier's analogue
+    of ``pipeline.pool.ClTask``).  Params enter the signature by OBJECT
+    identity — the worker buffer caches key on ``id(arr)``, so equal
+    shapes in different arrays are different dispatches (and different
+    coalescing groups)."""
+
+    params: Sequence = ()
+    kernels: Sequence[str] = ()
+    compute_id: int = 0
+    global_range: int = 0
+    local_range: int = 256
+    global_offset: int = 0
+    values: Sequence | dict = ()
+
+    def signature(self) -> tuple:
+        # the ONE shared construction (core/cores.job_signature): the
+        # grouping key here and the fused window's key must be the
+        # identical tuple, or batches silently stop matching open
+        # windows and every dispatch falls back to per-call
+        from ..core.cores import job_signature
+
+        return job_signature(
+            self.kernels, self.params, self.compute_id, self.global_range,
+            self.local_range, self.global_offset, self.values,
+        )
+
+
+@dataclass
+class _Request:
+    job: ServeJob
+    tenant: str
+    future: Future
+    t_submit: float
+    deadline_t: float | None  # absolute perf_counter, None = no deadline
+
+
+@dataclass
+class _Group:
+    key: str            # stable string id (plans/decisions/servez)
+    sig: tuple          # the signature tuple (the dict key)
+    reqs: list = field(default_factory=list)
+    starved: int = 0    # consecutive planning rounds not picked
+
+
+# -- /servez registry ---------------------------------------------------------
+_SERVEZ_MU = threading.Lock()
+_FRONTENDS: list = []  # weakrefs, pruned on read
+
+
+def _register_frontend(fe: "ServeFrontend") -> None:
+    with _SERVEZ_MU:
+        _FRONTENDS.append(weakref.ref(fe))
+
+
+def servez_payload() -> dict:
+    """The ``/servez`` debug-endpoint body: one row per live frontend
+    (snapshot-copy discipline — nothing here blocks a submit for longer
+    than the frontend's own small-state copy)."""
+    # prune and snapshot under ONE lock hold: a rewrite from a stale
+    # copy would permanently drop a frontend registered between the
+    # copy and the rewrite (invisible to /servez for its whole life)
+    with _SERVEZ_MU:
+        _FRONTENDS[:] = [r for r in _FRONTENDS if r() is not None]
+        fes = [r() for r in _FRONTENDS]
+    fronts = [fe.stats() for fe in fes if fe is not None]
+    return {"frontends": fronts, "count": len(fronts)}
+
+
+class ServeFrontend:
+    """The multi-tenant request front-end (see module docstring).
+
+    ``cruncher`` is a :class:`~cekirdekler_tpu.core.cruncher.NumberCruncher`
+    the frontend takes over as the single enqueue driver — no other
+    thread may drive computes through it while the frontend is open.
+    ``autostart=False`` leaves the dispatcher thread unstarted
+    (:meth:`step` runs one cycle synchronously — the deterministic
+    test/bench seam); :meth:`start` spins it up later."""
+
+    def __init__(
+        self,
+        cruncher,
+        admission: AdmissionController | None = None,
+        max_batch: int = 256,
+        max_groups_per_cycle: int = 0,
+        gather_window_s: float = 0.002,
+        name: str = "serve",
+        autostart: bool = True,
+    ):
+        self.name = str(name)
+        self.cruncher = cruncher
+        self.cores = cruncher.cores
+        self.admission = admission or AdmissionController(
+            health=self.cores.health.healthy)
+        self.tenants = TenantTable()
+        self.max_batch = max(1, int(max_batch))
+        self.max_groups_per_cycle = max(0, int(max_groups_per_cycle))
+        self.gather_window_s = max(0.0, float(gather_window_s))
+        # ONE lock/condition guards the whole admit→enqueue transition
+        # and the group table: quota decisions are exact under
+        # contention (the 32-thread test's contract), and the
+        # dispatcher's pops can never interleave half an admit
+        self._mu = threading.Condition()
+        # serializes whole dispatch cycles: close(drain=True)'s final
+        # step must never run concurrently with the dispatcher
+        # thread's — two steppers would both drive the single-driver
+        # Cores enqueue machinery (the contract the frontend exists
+        # to enforce)
+        self._step_mu = threading.Lock()
+        self._groups: dict[tuple, _Group] = {}
+        self._pending = 0
+        self._round = 0
+        self._batches = 0
+        self._requests_done = 0
+        self._group_seq = 0
+        # recent dispatch-cycle wall (EMA) — the retry-after scale
+        self._est_batch_s = 0.01
+        self._halt = False
+        self._thread: threading.Thread | None = None
+        # cached handles (submit/resolve are the serving hot path)
+        self._m_queue_depth = REGISTRY.gauge(
+            "ck_serve_queue_depth", "pending (admitted, undispatched) "
+            "serve requests")
+        self._m_batches = REGISTRY.counter(
+            "ck_serve_batches_total", "coalesced batches dispatched")
+        self._m_batch_iters = REGISTRY.histogram(
+            "ck_serve_batch_iters", "requests per coalesced batch",
+            buckets=_BATCH_BUCKETS)
+        _register_frontend(self)
+        if autostart:
+            self.start()
+
+    # -- client API ----------------------------------------------------------
+    def submit(self, tenant: str, job: ServeJob,
+               deadline: float | None = None) -> Future:
+        """Submit one job for ``tenant``; returns a
+        :class:`~concurrent.futures.Future` resolving to the request
+        record (``{"tenant", "latency_s", "batch_requests", "fused",
+        "deadline_missed", ...}``) after the batch's flush — the job's
+        host arrays are current at that point.  ``deadline`` is
+        seconds-from-now (deadline-aware ordering; a late completion is
+        flagged, never dropped).  Raises :class:`ServeRejected` (with
+        ``retry_after_s``) when admission refuses."""
+        if self._halt:
+            raise CekirdeklerError(f"frontend {self.name!r} is closed")
+        t0 = time.perf_counter()
+        jb = job if isinstance(job, ServeJob) else ServeJob(**job)
+        sig = jb.signature()
+        try:
+            hash(sig)
+        except TypeError:
+            raise ComputeValidationError(
+                "serve jobs need hashable values (array-valued value "
+                "args cannot coalesce)")
+        st = self.tenants.state(tenant)
+        fut: Future = Future()
+        with self._mu:
+            if self._halt:
+                # re-checked under the lock: a submit racing close()
+                # past the unlocked pre-check must not enqueue into a
+                # table close() already drained (its future would
+                # never resolve — a silent drop by another name)
+                raise CekirdeklerError(
+                    f"frontend {self.name!r} is closed")
+            inflight = self.tenants.note_request(st)
+            dec = self.admission.check(
+                tenant, inflight, self._pending, self._est_batch_s)
+            if dec["admit"]:
+                self.tenants.note_admitted(st)
+                g = self._groups.get(sig)
+                if g is None:
+                    self._group_seq += 1
+                    g = _Group(
+                        key=f"g{self._group_seq}-cid{jb.compute_id}",
+                        sig=sig)
+                    self._groups[sig] = g
+                g.reqs.append(_Request(
+                    job=jb, tenant=str(tenant), future=fut, t_submit=t0,
+                    deadline_t=(t0 + float(deadline)
+                                if deadline is not None else None),
+                ))
+                self._pending += 1
+                self._m_queue_depth.set(self._pending)
+                self._mu.notify()
+        if not dec["admit"]:
+            self.tenants.note_rejected(st, dec["reason"])
+            raise ServeRejected(
+                str(tenant), dec["reason"], float(dec["retry_after_s"]))
+        return fut
+
+    def call(self, tenant: str, job: ServeJob,
+             deadline: float | None = None, timeout: float | None = None):
+        """Blocking convenience: ``submit(...).result(timeout)``."""
+        return self.submit(tenant, job, deadline=deadline).result(timeout)
+
+    # -- the dispatcher ------------------------------------------------------
+    def start(self) -> None:
+        if self._thread is None or not self._thread.is_alive():
+            self._halt = False
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True,
+                name=f"ck-serve-{self.name}")
+            self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._halt:
+            with self._mu:
+                while self._pending == 0 and not self._halt:
+                    self._mu.wait(0.2)
+                if self._halt:
+                    break
+            if self.gather_window_s:
+                # the coalescing window: let a concurrent burst land in
+                # the groups before planning — this wait is what turns
+                # 32 near-simultaneous submits into one ladder
+                time.sleep(self.gather_window_s)
+            try:
+                self.step()
+            except Exception:  # noqa: BLE001 - step resolves futures; a
+                # planner/sync crash must not kill the serving thread
+                pass
+
+    def step(self) -> dict:
+        """Run ONE dispatch cycle synchronously: plan → dispatch each
+        picked group as a fused batch → barrier + flush → resolve
+        futures.  The test/bench seam (``autostart=False``) and the
+        dispatcher loop body.  Cycles are serialized (``_step_mu``):
+        the Cores enqueue machinery is single-driver by contract, so a
+        close-time drain and the dispatcher thread must take turns."""
+        with self._step_mu:
+            return self._step_locked()
+
+    def _step_locked(self) -> dict:
+        now = time.perf_counter()
+        with self._mu:
+            summary = []
+            for g in self._groups.values():
+                if not g.reqs:
+                    continue
+                deadlines = [r.deadline_t for r in g.reqs
+                             if r.deadline_t is not None]
+                summary.append({
+                    "key": g.key,
+                    "pending": len(g.reqs),
+                    "deadline_in_s": (min(deadlines) - now
+                                      if deadlines else None),
+                    "oldest_age_s": now - g.reqs[0].t_submit,
+                    "starved_rounds": g.starved,
+                })
+            rnd = self._round
+            self._round += 1
+        if not summary:
+            return {"batches": 0, "requests": 0}
+        summary.sort(key=lambda r: r["key"])
+        plan = plan_coalesce(summary, rnd, self.max_groups_per_cycle)
+        if DECISIONS.enabled:
+            DECISIONS.record("coalesce", {
+                "groups": summary, "round": rnd,
+                "max_picks": self.max_groups_per_cycle,
+            }, dict(plan))
+        picked = set(plan["picked"])
+        batches: list[tuple[_Group, list[_Request]]] = []
+        with self._mu:
+            for g in list(self._groups.values()):
+                if g.key in picked and g.reqs:
+                    take = g.reqs[: self.max_batch]
+                    del g.reqs[: len(take)]
+                    self._pending -= len(take)
+                    g.starved = 0
+                    batches.append((g, take))
+                elif g.reqs:
+                    g.starved += 1
+                if not g.reqs:
+                    # empty groups leave the table (their signature
+                    # re-registers on the next submit; the fused
+                    # window's candidate memory lives in Cores)
+                    self._groups.pop(g.sig, None)
+            self._m_queue_depth.set(self._pending)
+        if not batches:
+            return {"batches": 0, "requests": 0}
+        if not self.cores.enqueue_mode:
+            self.cores.enqueue_mode = True
+        results: list[tuple[list[_Request], dict | None, Exception | None]] \
+            = []
+        for g, reqs in batches:
+            jb = reqs[0].job
+            try:
+                info = self.cores.compute_fused_batch(
+                    list(jb.kernels), list(jb.params), jb.compute_id,
+                    jb.global_range, jb.local_range, len(reqs),
+                    global_offset=jb.global_offset, value_args=jb.values,
+                )
+                results.append((reqs, info, None))
+            except Exception as e:  # noqa: BLE001 - fails THIS batch only
+                results.append((reqs, None, e))
+        sync_err: Exception | None = None
+        try:
+            self.cores.barrier()   # balancer feedback for the window
+            self.cores.flush()     # host results for the resolving futures
+        except Exception as e:  # noqa: BLE001 - fails the cycle's futures
+            sync_err = e
+        t_done = time.perf_counter()
+        with self._mu:
+            self._est_batch_s = (
+                0.5 * self._est_batch_s + 0.5 * max(t_done - now, 1e-4))
+            self._batches += len(batches)
+        n_requests = 0
+        for reqs, info, err in results:
+            err = err or sync_err
+            self._m_batches.inc()
+            self._m_batch_iters.observe(len(reqs))
+            for r in reqs:
+                n_requests += 1
+                st = self.tenants.state(r.tenant)
+                lat = t_done - r.t_submit
+                if err is not None:
+                    self.tenants.note_done(
+                        st, lat, failed=True, deadline_missed=False)
+                    r.future.set_exception(err)
+                    continue
+                missed = (r.deadline_t is not None
+                          and t_done > r.deadline_t)
+                self.tenants.note_done(
+                    st, lat, failed=False, deadline_missed=missed)
+                r.future.set_result({
+                    "tenant": r.tenant,
+                    "latency_s": lat,
+                    "batch_requests": len(reqs),
+                    "fused": bool(info and info.get("fused")),
+                    "ladder_iters": (info or {}).get("ladder_iters", 0),
+                    "deadline_missed": missed,
+                })
+        with self._mu:
+            self._requests_done += n_requests
+        return {"batches": len(batches), "requests": n_requests,
+                "plan": plan}
+
+    # -- views / lifecycle ---------------------------------------------------
+    def stats(self) -> dict:
+        """The ``/servez`` row for this frontend — snapshot copies
+        only."""
+        with self._mu:
+            groups = [
+                {"key": g.key, "pending": len(g.reqs), "starved": g.starved,
+                 "cid": g.sig[0]}
+                for g in self._groups.values() if g.reqs
+            ]
+            doc = {
+                "name": self.name,
+                "queue_depth": self._pending,
+                "rounds": self._round,
+                "batches": self._batches,
+                "requests_done": self._requests_done,
+                "est_batch_s": round(self._est_batch_s, 6),
+                "max_batch": self.max_batch,
+                "max_groups_per_cycle": self.max_groups_per_cycle,
+                "dispatcher_alive": (self._thread is not None
+                                     and self._thread.is_alive()),
+                "groups": sorted(groups, key=lambda g: g["key"]),
+            }
+        doc["tenants"] = self.tenants.snapshot()
+        doc["admission"] = {
+            "max_queue_depth": self.admission.max_queue_depth,
+            "default_quota": self.admission.default_quota.max_inflight,
+            "healthy": self.admission.healthy(),
+        }
+        return doc
+
+    def close(self, drain: bool = True) -> None:
+        """Stop the dispatcher.  With ``drain`` (default) pending work
+        runs one final cycle first; anything still queued after that
+        fails its future with a named shutdown error (never a silent
+        drop — the admission contract applied to shutdown)."""
+        if drain and self._pending:
+            try:
+                self.step()
+            except Exception:  # noqa: BLE001 - shutdown must proceed
+                pass
+        self._halt = True
+        with self._mu:
+            self._mu.notify_all()
+            leftovers = []
+            for g in self._groups.values():
+                leftovers.extend(g.reqs)
+                g.reqs = []
+            self._groups.clear()
+            self._pending = 0
+            self._m_queue_depth.set(0)
+        for r in leftovers:
+            st = self.tenants.state(r.tenant)
+            self.tenants.note_done(
+                st, time.perf_counter() - r.t_submit, failed=True,
+                deadline_missed=False)
+            r.future.set_exception(
+                CekirdeklerError(f"frontend {self.name!r} closed with the "
+                                 "request still queued"))
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+
+    def __enter__(self) -> "ServeFrontend":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

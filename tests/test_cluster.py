@@ -171,6 +171,83 @@ def test_node_failure_mid_run_fails_over_to_mainframe(two_servers):
         cluster.dispose()
 
 
+def test_concurrent_sessions_do_not_serialize(two_servers):
+    """ISSUE 11 satellite: a second concurrent session SETUPs and
+    COMPUTEs while the first session is mid-conversation AND mid-compute
+    — per-connection session threads, nothing serializes them."""
+    import threading
+
+    s1, _ = two_servers
+    n = 4096
+    a = CruncherClient(s1.host, s1.port)
+    b = CruncherClient(s1.host, s1.port)
+    try:
+        assert a.setup(SRC) == 2
+        xa = ClArray(np.arange(n, dtype=np.float32), partial_read=True,
+                     read_only=True)
+        ya = ClArray(np.ones(n, np.float32), partial_read=True)
+        errs: list = []
+
+        def drive_a():
+            try:
+                for _ in range(6):
+                    a.compute(["saxpy"], [xa, ya], 20, 0, n, 64,
+                              values=(1.0,))
+            except Exception as e:  # noqa: BLE001 - surfaced below
+                errs.append(e)
+
+        ta = threading.Thread(target=drive_a)
+        ta.start()
+        # B's whole lifecycle runs while A's session computes
+        assert b.setup(SRC) == 2
+        xb = ClArray(np.arange(n, dtype=np.float32), partial_read=True,
+                     read_only=True)
+        yb = ClArray(np.zeros(n, np.float32), partial_read=True)
+        b.compute(["saxpy"], [xb, yb], 21, 0, n, 64, values=(3.0,))
+        np.testing.assert_allclose(yb.host(), 3.0 * xb.host(), rtol=1e-6)
+        ta.join(timeout=60)
+        assert not ta.is_alive() and not errs, errs
+        np.testing.assert_allclose(
+            ya.host(), 1.0 + 6.0 * xa.host(), rtol=1e-6)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_session_capacity_rejected_with_named_error():
+    """Beyond max_sessions a connection is answered with a NAMED error
+    (never a hang), and capacity frees when a session ends."""
+    import time as _t
+
+    from cekirdekler_tpu.errors import CekirdeklerError
+
+    server = CruncherServer(devices=_cpus(2), max_sessions=1)
+    try:
+        a = CruncherClient(server.host, server.port)
+        assert a.setup(SRC) == 2  # occupies the one session slot
+        b = CruncherClient(server.host, server.port)
+        with pytest.raises(CekirdeklerError, match="capacity"):
+            b.setup(SRC)
+        b.close()
+        a.close()
+        # the freed slot admits a new session (the accept loop reaps
+        # dead session threads; poll briefly for the teardown)
+        deadline = _t.monotonic() + 10.0
+        while True:
+            c = CruncherClient(server.host, server.port)
+            try:
+                assert c.setup(SRC) == 2
+                break
+            except CekirdeklerError:
+                c.close()
+                if _t.monotonic() > deadline:
+                    raise
+                _t.sleep(0.05)
+        c.close()
+    finally:
+        server.stop()
+
+
 def test_probe_finds_live_servers(two_servers):
     s1, s2 = two_servers
     live = ClusterAccelerator.probe(

@@ -33,6 +33,10 @@ HEADLINE = {
     "nbody_e2e_enqueue_gpairs": 15.0,
     "dispatch_floor_collapse": 5.0,
     "overlap_balanced_raw": 0.80,
+    "serve_p50_ms": 8.0,
+    "serve_p99_ms": 40.0,
+    "serve_goodput_rps": 400.0,
+    "serve_coalesce_ratio": 4.0,
 }
 
 
@@ -58,8 +62,28 @@ def test_injected_20pct_regression_fails_with_exit_2():
     assert v["findings"][0]["drop_frac"] > 0.19
 
 
+def test_lower_direction_latency_regression_fails():
+    """The serve latency keys watch LOWER-is-better: p50 doubling is a
+    regression; p50 halving is an improvement and never fails."""
+    bad = dict(HEADLINE)
+    bad["serve_p50_ms"] *= 2.0
+    v = regress.diff_headlines(_art(HEADLINE), _art(bad))
+    assert not v["ok"] and v["exit_code"] == 2
+    assert [f["key"] for f in v["findings"]] == ["serve_p50_ms"]
+    good = dict(HEADLINE)
+    good["serve_p50_ms"] *= 0.5
+    good["serve_goodput_rps"] *= 2.0
+    v = regress.diff_headlines(_art(HEADLINE), _art(good))
+    assert v["ok"] and v["findings"] == []
+
+
 def test_improvements_never_fail():
-    better = {k: v * 2 for k, v in HEADLINE.items()}
+    # "better" respects each key's direction: higher-is-better keys
+    # double, lower-is-better keys (the serve latencies) halve
+    lower = {k for k, _a, d, _t in regress.WATCHED_KEYS if d == "lower"}
+    better = {
+        k: (v * 0.5 if k in lower else v * 2) for k, v in HEADLINE.items()
+    }
     v = regress.diff_headlines(_art(HEADLINE), _art(better))
     assert v["ok"]
 

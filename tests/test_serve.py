@@ -1,0 +1,508 @@
+"""Serving tier (serve/): admission purity + exact quota accounting,
+coalescing plans (EDF + starvation fairness), the externally-assembled
+fused-batch entry, 32-thread mixed-signature contention coalescing into
+fewer ladder launches than requests, decision replay, and /servez.
+
+The inc kernel adds exactly 1.0f — small-integer f32 arithmetic is
+exact, so every lost or duplicated request shows as an integer-sized
+error and the assertions demand bit equality (the test_fused.py
+discipline, applied to the serving path)."""
+
+import json
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from cekirdekler_tpu import ClArray
+from cekirdekler_tpu.core import NumberCruncher
+from cekirdekler_tpu.errors import ComputeValidationError
+from cekirdekler_tpu.hardware import platforms
+from cekirdekler_tpu.metrics.registry import REGISTRY
+from cekirdekler_tpu.obs.decisions import DECISIONS
+from cekirdekler_tpu.obs.replay import replay_record, verify_records
+from cekirdekler_tpu.serve import (
+    AdmissionController,
+    ServeFrontend,
+    ServeJob,
+    ServeRejected,
+    admit_decision,
+    plan_coalesce,
+    servez_payload,
+)
+from cekirdekler_tpu.serve.admission import (
+    REJECT_HEALTH,
+    REJECT_QUEUE,
+    REJECT_QUOTA,
+)
+
+INC = """
+__kernel void inc(__global float* a) {
+    int i = get_global_id(0);
+    a[i] = a[i] + 1.0f;
+}
+__kernel void dbl(__global float* a) {
+    int i = get_global_id(0);
+    a[i] = a[i] * 1.001f;
+}
+"""
+
+
+@pytest.fixture(scope="module")
+def devs():
+    return platforms().cpus()
+
+
+def _mk(devs, n=1024, sigs=1, lanes=2):
+    cr = NumberCruncher(devs.subset(lanes), INC)
+    arrays = []
+    jobs = []
+    for s in range(sigs):
+        a = ClArray(np.zeros(n, np.float32), name=f"s{s}")
+        a.partial_read = True
+        arrays.append(a)
+        jobs.append(ServeJob(params=[a], kernels=["inc"],
+                             compute_id=700 + s, global_range=n,
+                             local_range=64))
+    return cr, arrays, jobs
+
+
+# ---------------------------------------------------------------------------
+# admission: the pure decision + the controller
+# ---------------------------------------------------------------------------
+
+def test_admit_decision_check_order_and_retry_after():
+    """health gates first, then queue depth, then quota; retry-after is
+    deterministic and scales with the batch-wall estimate."""
+    kw = dict(tenant_inflight=0, quota=4, queue_depth=0,
+              max_queue_depth=8, healthy=True, est_batch_s=0.02)
+    assert admit_decision(**kw) == {
+        "admit": True, "reason": None, "retry_after_s": None}
+    d = admit_decision(**dict(kw, healthy=False, queue_depth=99,
+                              tenant_inflight=99))
+    assert d["reason"] == REJECT_HEALTH  # health outranks the others
+    assert d["retry_after_s"] == pytest.approx(0.08)
+    d = admit_decision(**dict(kw, queue_depth=8, tenant_inflight=99))
+    assert d["reason"] == REJECT_QUEUE   # queue outranks quota
+    d = admit_decision(**dict(kw, tenant_inflight=4))
+    assert d["reason"] == REJECT_QUOTA
+    assert d["retry_after_s"] == pytest.approx(0.02)
+    # determinism: same inputs, same floats (the replay contract)
+    assert admit_decision(**dict(kw, tenant_inflight=4)) == d
+
+
+def test_admission_controller_records_replayable_decisions():
+    ctrl = AdmissionController(max_queue_depth=2, default_quota=1)
+    DECISIONS.clear()
+    assert ctrl.check("a", 0, 0, 0.01)["admit"] is True
+    assert ctrl.check("a", 1, 0, 0.01)["reason"] == REJECT_QUOTA
+    assert ctrl.check("b", 0, 5, 0.01)["reason"] == REJECT_QUEUE
+    recs = [r for r in DECISIONS.snapshot() if r.kind == "admission"]
+    assert len(recs) == 3
+    for r in recs:
+        rep = replay_record(r)
+        assert rep["ok"] is True, rep
+
+
+def test_admission_health_gate_flips():
+    healthy = [False]
+    ctrl = AdmissionController(health=lambda: healthy[0], health_ttl_s=0.0)
+    assert ctrl.check("a", 0, 0, 0.01)["reason"] == REJECT_HEALTH
+    healthy[0] = True
+    assert ctrl.check("a", 0, 0, 0.01)["admit"] is True
+
+
+# ---------------------------------------------------------------------------
+# coalescer: the pure plan
+# ---------------------------------------------------------------------------
+
+def _group(key, pending=1, deadline=None, age=0.0, starved=0):
+    return {"key": key, "pending": pending, "deadline_in_s": deadline,
+            "oldest_age_s": age, "starved_rounds": starved}
+
+
+def test_plan_edf_then_age_then_key():
+    plan = plan_coalesce([
+        _group("a", age=0.5),
+        _group("b", deadline=0.2, age=0.1),
+        _group("c", deadline=0.1, age=0.1),
+        _group("d", age=0.9),
+    ], round_idx=0)
+    # deadlined groups first (earliest first), then oldest arrival
+    assert plan["order"] == ["c", "b", "d", "a"]
+    assert plan["picked"] == plan["order"]  # unbounded cycle picks all
+    assert plan["promoted"] == []
+
+
+def test_plan_fairness_promotion_and_rotation():
+    groups = [
+        _group("urgent", deadline=0.01),
+        _group("x", starved=2),
+        _group("y", starved=3),
+    ]
+    p0 = plan_coalesce(groups, round_idx=0, max_picks=1)
+    p1 = plan_coalesce(groups, round_idx=1, max_picks=1)
+    # both streak members are promoted AHEAD of the deadlined group,
+    # and the head slot rotates with the round anchor
+    assert p0["promoted"] in (["x", "y"], ["y", "x"])
+    assert p1["promoted"] != p0["promoted"]
+    assert p0["order"][-1] == "urgent"
+    assert p0["picked"] == [p0["order"][0]]
+    # determinism (the replay contract)
+    assert plan_coalesce(groups, 0, 1) == p0
+
+
+def test_plan_zero_pending_groups_drop_out():
+    plan = plan_coalesce([_group("a", pending=0), _group("b")], 0)
+    assert plan["order"] == ["b"]
+
+
+# ---------------------------------------------------------------------------
+# Cores.compute_fused_batch: the externally-assembled batch entry
+# ---------------------------------------------------------------------------
+
+def test_compute_fused_batch_exact_and_one_ladder(devs):
+    cr, (x,), (job,) = _mk(devs)
+    try:
+        cr.enqueue_mode = True
+        info = cr.cores.compute_fused_batch(
+            ["inc"], [x], 700, 1024, 64, 12)
+        cr.cores.barrier()
+        cr.cores.flush()
+        np.testing.assert_array_equal(np.asarray(x), 12.0)
+        # first batch: seed + engage per-call, the residue as ONE ladder
+        assert info == {"iters": 12, "fused": True, "ladder_iters": 10,
+                        "per_call_iters": 2}
+        # warm candidate: the next batch pays ONE per-call iteration
+        info2 = cr.cores.compute_fused_batch(
+            ["inc"], [x], 700, 1024, 64, 12)
+        cr.cores.barrier()
+        cr.cores.flush()
+        np.testing.assert_array_equal(np.asarray(x), 24.0)
+        assert info2["per_call_iters"] == 1
+        assert info2["ladder_iters"] == 11
+    finally:
+        cr.dispose()
+
+
+def test_compute_fused_batch_requires_enqueue_and_falls_back(devs):
+    cr, (x,), (job,) = _mk(devs)
+    try:
+        with pytest.raises(ComputeValidationError):
+            cr.cores.compute_fused_batch(["inc"], [x], 700, 1024, 64, 4)
+        # fusion off: per-call fallback stays bit-exact
+        cr.fused_dispatch = False
+        cr.enqueue_mode = True
+        info = cr.cores.compute_fused_batch(["inc"], [x], 700, 1024, 64, 5)
+        cr.cores.barrier()
+        cr.cores.flush()
+        assert info["fused"] is False and info["per_call_iters"] == 5
+        np.testing.assert_array_equal(np.asarray(x), 5.0)
+    finally:
+        cr.dispose()
+
+
+# ---------------------------------------------------------------------------
+# frontend: exactness, quotas, contention, replay
+# ---------------------------------------------------------------------------
+
+def test_frontend_coalesces_and_resolves_exact(devs):
+    cr, (x,), (job,) = _mk(devs)
+    fe = ServeFrontend(cr, autostart=False, name="exact")
+    try:
+        w0 = cr.cores.fused_stats["windows"]
+        futs = [fe.submit("tA", job) for _ in range(16)]
+        out = fe.step()
+        assert out["batches"] == 1 and out["requests"] == 16
+        recs = [f.result(timeout=30) for f in futs]
+        np.testing.assert_array_equal(np.asarray(x), 16.0)
+        assert all(r["batch_requests"] == 16 for r in recs)
+        assert cr.cores.fused_stats["windows"] - w0 == 1  # ONE ladder
+    finally:
+        fe.close()
+        cr.dispose()
+
+
+def test_frontend_quota_rejections_exact_under_contention(devs):
+    """32 threads, one tenant, quota 6, dispatcher paused: EXACTLY
+    quota admits and the rest reject with retry-after — the admission
+    transition is atomic under the frontend lock."""
+    cr, (x,), (job,) = _mk(devs)
+    fe = ServeFrontend(cr, autostart=False, name="quota")
+    fe.admission.set_quota("tQ", 6)
+    rejected = []
+    futs = []
+    mu = threading.Lock()
+
+    def client():
+        try:
+            f = fe.submit("tQ", job)
+            with mu:
+                futs.append(f)
+        except ServeRejected as e:
+            assert e.reason == REJECT_QUOTA
+            assert e.retry_after_s > 0
+            with mu:
+                rejected.append(e)
+
+    threads = [threading.Thread(target=client) for _ in range(32)]
+    try:
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(10)
+        assert len(futs) == 6
+        assert len(rejected) == 26
+        snap = fe.tenants.snapshot()["tQ"]
+        assert snap["admitted"] == 6 and snap["rejected"] == 26
+        assert REGISTRY.counter(
+            "ck_serve_rejected_total", "serve submits rejected",
+            tenant="tQ", reason=REJECT_QUOTA,
+        ).value >= 26
+        fe.step()
+        for f in futs:
+            f.result(timeout=30)
+        np.testing.assert_array_equal(np.asarray(x), 6.0)
+    finally:
+        fe.close()
+        cr.dispose()
+
+
+def test_frontend_32_threads_mixed_signatures_coalesce(devs):
+    """The ISSUE 11 contention pin: 32 concurrent clients × mixed
+    signatures complete bit-exactly AND coalesce into measurably fewer
+    ladder launches than requests (ck_fused_windows + per-call count)."""
+    cr, arrays, jobs = _mk(devs, sigs=4)
+    fe = ServeFrontend(cr, gather_window_s=0.01, name="contention")
+    n_clients, per_client = 32, 6
+    m_windows = REGISTRY.counter(
+        "ck_fused_windows_total", "fused ladder dispatch batches")
+    m_iters = REGISTRY.counter(
+        "ck_fused_iters_total", "iterations dispatched via fused ladders")
+    w0, i0 = m_windows.value, m_iters.value
+    per_sig = [0] * len(jobs)
+    mu = threading.Lock()
+
+    def client(ci):
+        for k in range(per_client):
+            s = (ci + k) % len(jobs)
+            fe.submit(f"t{ci % 4}", jobs[s]).result(timeout=60)
+            with mu:
+                per_sig[s] += 1
+
+    threads = [threading.Thread(target=client, args=(ci,))
+               for ci in range(n_clients)]
+    try:
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(120)
+        assert not any(t.is_alive() for t in threads)
+        requests = n_clients * per_client
+        assert sum(per_sig) == requests
+        # bit-exact per signature: every request applied +1 exactly once
+        for s, a in enumerate(arrays):
+            np.testing.assert_array_equal(np.asarray(a), float(per_sig[s]))
+        # the coalescing evidence: ladder launches < requests
+        windows = m_windows.value - w0
+        per_call = requests - (m_iters.value - i0)
+        assert windows + per_call < requests, (
+            f"no coalescing: {windows} windows + {per_call} per-call "
+            f">= {requests} requests")
+    finally:
+        fe.close()
+        cr.dispose()
+
+
+def test_frontend_deadline_ordering_and_miss_flag(devs):
+    cr, arrays, jobs = _mk(devs, sigs=2)
+    fe = ServeFrontend(cr, autostart=False, name="deadline")
+    try:
+        f_slow = fe.submit("tA", jobs[0])               # no deadline
+        f_urgent = fe.submit("tB", jobs[1], deadline=5.0)
+        out = fe.step()
+        plan = out["plan"]
+        # the deadlined group dispatches first
+        assert plan["order"][0].endswith("cid701")
+        assert f_urgent.result(10)["deadline_missed"] is False
+        f_slow.result(10)
+        # an already-expired deadline completes and is FLAGGED, not dropped
+        f_late = fe.submit("tA", jobs[0], deadline=-0.001)
+        fe.step()
+        assert f_late.result(10)["deadline_missed"] is True
+        assert fe.tenants.snapshot()["tA"]["deadline_missed"] == 1
+    finally:
+        fe.close()
+        cr.dispose()
+
+
+def test_frontend_unhealthy_rejects_with_retry_after(devs):
+    cr, _arrays, (job,) = _mk(devs)
+    healthy = [False]
+    fe = ServeFrontend(
+        cr, admission=AdmissionController(health=lambda: healthy[0],
+                                          health_ttl_s=0.0),
+        autostart=False, name="health")
+    try:
+        with pytest.raises(ServeRejected) as exc:
+            fe.submit("tA", job)
+        assert exc.value.reason == REJECT_HEALTH
+        assert exc.value.retry_after_s > 0
+        healthy[0] = True
+        fe.submit("tA", job)
+        fe.step()
+    finally:
+        fe.close()
+        cr.dispose()
+
+
+def test_frontend_queue_backpressure(devs):
+    cr, _arrays, (job,) = _mk(devs)
+    fe = ServeFrontend(
+        cr, admission=AdmissionController(max_queue_depth=3,
+                                          default_quota=100),
+        autostart=False, name="backpressure")
+    try:
+        for _ in range(3):
+            fe.submit("tA", job)
+        with pytest.raises(ServeRejected) as exc:
+            fe.submit("tA", job)
+        assert exc.value.reason == REJECT_QUEUE
+        fe.step()  # drains; admission opens again
+        fe.submit("tA", job)
+        fe.step()
+    finally:
+        fe.close()
+        cr.dispose()
+
+
+def test_serve_decisions_replay_green_and_tamper_diverges(devs):
+    """Every admission/coalesce decision a serve run records replays
+    bit-identically; a tampered output names its seq (the acceptance
+    criterion's replay half)."""
+    cr, _arrays, (job,) = _mk(devs)
+    fe = ServeFrontend(cr, autostart=False, name="replay")
+    DECISIONS.clear()
+    try:
+        for _ in range(8):
+            fe.submit("tA", job)
+        fe.step()
+        fe.admission.set_quota("tB", 1)
+        fe.submit("tB", job)
+        with pytest.raises(ServeRejected):
+            fe.submit("tB", job)
+        fe.step()
+        rows = [r.to_row() for r in DECISIONS.snapshot()
+                if r.kind in ("admission", "coalesce")]
+        assert len([r for r in rows if r["kind"] == "admission"]) == 10
+        assert len([r for r in rows if r["kind"] == "coalesce"]) == 2
+        verdict = verify_records(rows)
+        assert verdict["ok"] is True, verdict
+        assert verdict["replayed"] == len(rows)
+        # tamper: a rewritten admission outcome must diverge at its seq
+        bad = json.loads(json.dumps(rows[0]))
+        bad["outputs"]["admit"] = not bad["outputs"]["admit"]
+        v2 = verify_records([bad])
+        assert v2["ok"] is False
+        assert v2["first_divergence"]["seq"] == bad["seq"]
+    finally:
+        fe.close()
+        cr.dispose()
+
+
+def test_frontend_close_fails_leftovers_with_named_error(devs):
+    cr, _arrays, (job,) = _mk(devs)
+    fe = ServeFrontend(cr, autostart=False, name="shutdown")
+    fut = fe.submit("tA", job)
+    fe.close(drain=False)
+    with pytest.raises(Exception, match="closed"):
+        fut.result(timeout=5)
+    with pytest.raises(Exception, match="closed"):
+        fe.submit("tA", job)
+    cr.dispose()
+
+
+# ---------------------------------------------------------------------------
+# /servez + dispatcher thread
+# ---------------------------------------------------------------------------
+
+def test_servez_payload_and_endpoint(devs):
+    cr, _arrays, (job,) = _mk(devs)
+    fe = ServeFrontend(cr, gather_window_s=0.001, name="servez")
+    try:
+        for _ in range(6):
+            fe.submit("tZ", job).result(timeout=30)
+        doc = servez_payload()
+        mine = [f for f in doc["frontends"] if f["name"] == "servez"]
+        assert mine and mine[0]["requests_done"] == 6
+        assert mine[0]["tenants"]["tZ"]["completed"] == 6
+        srv = cr.serve_debug(port=0)
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/servez", timeout=10) as r:
+            body = json.loads(r.read())
+        assert any(f["name"] == "servez" for f in body["frontends"])
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/", timeout=10) as r:
+            assert "/servez" in json.loads(r.read())["endpoints"]
+    finally:
+        fe.close()
+        cr.dispose()
+
+
+# ---------------------------------------------------------------------------
+# satellites riding this module: pool tenant tags, loadgen smoke
+# ---------------------------------------------------------------------------
+
+def test_pool_tenant_tag_passthrough(devs):
+    from cekirdekler_tpu.pipeline.pool import ClDevicePool, ClTask, ClTaskPool
+
+    n = 512
+    x = ClArray(np.zeros(n, np.float32), name="pt")
+    x.partial_read = True
+    staged = ClTaskPool([
+        x.task(31, "inc", n, 64),
+        x.task(31, "inc", n, 64),
+    ])
+    tagged = ClTaskPool()
+    tagged.feed(staged, tenant="tP")
+    assert all(t.tenant == "tP" for t in tagged.snapshot())
+    # a pre-tagged task keeps its own tenant through an untagged feed
+    own = ClTask(params=[x], kernel_names=["inc"], compute_id=31,
+                 global_range=n, local_range=64, tenant="keep")
+    keep = ClTaskPool([own])
+    merged = ClTaskPool()
+    merged.feed(keep, tenant="tP")
+    assert merged.snapshot()[0].tenant == "keep"
+    # untagged feed changes nothing (the no-behavior-change contract)
+    plain = ClTaskPool()
+    plain.feed(staged)
+    assert all(t.tenant is None for t in plain.snapshot())
+    with ClDevicePool(devs.subset(1), INC) as pool:
+        pool.enqueue_task_pool(tagged)
+        pool.finish()
+    np.testing.assert_array_equal(np.asarray(x), 2.0)
+    snap = REGISTRY.snapshot()
+    assert any(
+        'ck_pool_tasks_total{' in k and 'tenant="tP"' in k
+        for k in (snap.get("counters") or {})
+    ), "tenant-labeled pool-task series missing"
+
+
+def test_loadgen_smoke(devs):
+    import importlib.util
+    import os
+
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "ck_loadgen_test", os.path.join(here, "tools", "loadgen.py"))
+    lg = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(lg)
+    out = lg.run_loadgen(devs, clients=8, tenants=2, signatures=2,
+                         requests_per_client=4, n=2048)
+    assert out["completed"] == 32 and out["failed"] == 0
+    assert out["checked"] is True
+    assert out["coalesced"] is True, out
+    assert out["ladder_launches"] < out["completed"]
+    assert out["p50_ms"] > 0 and out["p99_ms"] >= out["p50_ms"]
+    assert out["goodput_rps"] > 0

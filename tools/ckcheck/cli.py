@@ -51,6 +51,12 @@ HOT_ROOTS = (
     "trace.spans.Tracer.instant",
     "trace.device.DeviceMarks.begin",
     "trace.device.DeviceMarks.end",
+    # the serving tier's submit→coalesce path (ISSUE 11): every client
+    # request pays submit; the decision-record inputs stay behind
+    # DECISIONS.enabled, tenant metric handles are cached at first
+    # sight, and only the allowlisted frontend/table locks may be taken
+    "serve.frontend.ServeFrontend.submit",
+    "serve.admission.AdmissionController.check",
 )
 
 #: Locks the hot path may take: the scheduler lock + fused-window mutex
@@ -63,6 +69,14 @@ HOT_LOCK_ALLOW = (
     "core.cores.Cores._fused_mu",
     "core.worker._DriverQueue._cond",
     "metrics.registry._Metric._lock",
+    # serving submit path: ONE frontend condition guards the whole
+    # admit→enqueue transition (exact quota counts under contention
+    # are the contract), with the tenant table's and admission
+    # controller's small-state locks nested inside it — each held for
+    # a few dict operations per request, the documented budget
+    "serve.frontend.ServeFrontend._mu",
+    "serve.tenants.TenantTable._mu",
+    "serve.admission.AdmissionController._mu",
 )
 
 

@@ -1,0 +1,332 @@
+#!/usr/bin/env python
+"""Serving-tier load generator: N concurrent clients against one
+``ServeFrontend`` (docs/SERVING.md), open- or closed-loop.
+
+Run from the repo root:
+
+    python tools/loadgen.py [--clients 32] [--tenants 4] [--signatures 4]
+                            [--requests 8] [--mode closed|open|both]
+                            [--rate 200] [--n 16384] [--json]
+
+- **closed loop**: every client submits its next request only after the
+  previous one resolved — the latency-under-concurrency measurement
+  (``p50_ms`` / ``p99_ms`` headline keys).
+- **open loop**: clients submit at a fixed per-client rate without
+  waiting (rejections count, retries honor ``retry_after_s``) — the
+  goodput measurement (``goodput_rps``: completed requests per second
+  of wall).
+
+Either way the run reports the **coalescing evidence**: requests vs
+actual ladder dispatches (fused windows + per-call iterations, read as
+``ck_fused_*`` counter deltas) as ``coalesce_ratio`` — the "N requests
+collapse into measurably fewer ladder launches" number the ROADMAP
+acceptance names — and verifies the workload bit-exactly (every
+signature's array must equal its completed-request count; the inc
+kernel makes lost/duplicated requests integer-visible).
+
+``bench.py``'s ``serving`` section runs :func:`loadgen_section` (closed
++ open) and mints the four headline keys ``tools/regress.py`` watches.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_JSONSAFE = None
+
+
+def _json_safe(o):
+    """Delegates to tools/_jsonsafe.py (loaded by file path — this tool
+    must run standalone, via `python tools/<name>.py`, AND as an
+    importlib-loaded module with no package context)."""
+    global _JSONSAFE
+    if _JSONSAFE is None:
+        import importlib.util
+
+        p = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "_jsonsafe.py")
+        spec = importlib.util.spec_from_file_location("ck_tools_jsonsafe", p)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        _JSONSAFE = mod.json_safe
+    return _JSONSAFE(o)
+
+
+#: The workload kernel: +1.0f per request — small-integer f32 math is
+#: exact, so the post-run check can demand bit equality between each
+#: array and its signature's completed-request count.
+LOADGEN_SRC = """
+__kernel void lg_inc(__global float* a) {
+    int i = get_global_id(0);
+    a[i] = a[i] + 1.0f;
+}
+"""
+
+
+def _percentile(sorted_vals: list, q: float) -> float:
+    """Nearest-rank percentile of an ASCENDING list (no numpy — the
+    tool must import light)."""
+    if not sorted_vals:
+        return 0.0
+    k = max(0, min(len(sorted_vals) - 1,
+                   int(round(q * (len(sorted_vals) - 1)))))
+    return float(sorted_vals[k])
+
+
+def run_loadgen(
+    devices=None,
+    clients: int = 32,
+    tenants: int = 4,
+    signatures: int = 4,
+    requests_per_client: int = 8,
+    mode: str = "closed",
+    rate_rps: float = 200.0,
+    n: int = 1 << 14,
+    local_range: int = 64,
+    gather_window_s: float = 0.004,
+    max_batch: int = 512,
+    quota: int = 0,
+    max_queue_depth: int = 0,
+    max_retries: int = 50,
+) -> dict:
+    """One load-generator run (see module docstring).  Returns the
+    result dict with p50/p99 latency, goodput, the coalescing evidence,
+    and the exactness check."""
+    import numpy as np
+
+    from cekirdekler_tpu import ClArray
+    from cekirdekler_tpu.core.cruncher import NumberCruncher
+    from cekirdekler_tpu.hardware import all_devices
+    from cekirdekler_tpu.metrics.registry import REGISTRY
+    from cekirdekler_tpu.serve import (
+        AdmissionController,
+        ServeFrontend,
+        ServeJob,
+        ServeRejected,
+    )
+
+    if mode not in ("closed", "open"):
+        raise ValueError(f"mode must be closed|open, got {mode!r}")
+    devs = devices if devices is not None else all_devices().cpus()
+    devs = devs.subset(min(2, len(devs)) or 1)
+    clients = max(1, int(clients))
+    tenants = max(1, int(tenants))
+    signatures = max(1, int(signatures))
+    total_target = clients * max(1, int(requests_per_client))
+
+    cr = NumberCruncher(devs, LOADGEN_SRC)
+    arrays = []
+    jobs = []
+    for s in range(signatures):
+        a = ClArray(np.zeros(n, np.float32), name=f"lg{s}")
+        a.partial_read = True
+        arrays.append(a)
+        jobs.append(ServeJob(
+            params=[a], kernels=["lg_inc"], compute_id=9100 + s,
+            global_range=n, local_range=local_range,
+        ))
+    admission = AdmissionController(
+        max_queue_depth=(int(max_queue_depth) if max_queue_depth
+                         else max(64, 4 * total_target)),
+        default_quota=(int(quota) if quota else max(8, total_target)),
+        health=cr.cores.health.healthy,
+    )
+    fe = ServeFrontend(
+        cr, admission=admission, max_batch=max_batch,
+        gather_window_s=gather_window_s, name=f"loadgen-{mode}",
+    )
+
+    m_windows = REGISTRY.counter(
+        "ck_fused_windows_total", "fused ladder dispatch batches")
+    m_iters = REGISTRY.counter(
+        "ck_fused_iters_total", "iterations dispatched via fused ladders")
+    w0, i0 = m_windows.value, m_iters.value
+
+    latencies: list[float] = []
+    completed_per_sig = [0] * signatures
+    rejected = [0]
+    retries_exhausted = [0]
+    failed = [0]
+    mu = threading.Lock()
+
+    def submit_with_retry(tenant: str, job: ServeJob):
+        """Submit honoring retry-after (the admission contract's client
+        half); returns the future or None when retries ran out."""
+        for _ in range(max(1, int(max_retries))):
+            try:
+                return fe.submit(tenant, job)
+            except ServeRejected as e:
+                with mu:
+                    rejected[0] += 1
+                time.sleep(min(e.retry_after_s, 0.25))
+        with mu:
+            retries_exhausted[0] += 1
+        return None
+
+    def note_done(fut, sig_idx: int):
+        try:
+            r = fut.result(timeout=60.0)
+        except Exception:  # noqa: BLE001 - counted, checked below
+            with mu:
+                failed[0] += 1
+            return
+        with mu:
+            latencies.append(r["latency_s"])
+            completed_per_sig[sig_idx] += 1
+
+    def client_closed(ci: int):
+        tenant = f"t{ci % tenants}"
+        for k in range(int(requests_per_client)):
+            sig_idx = (ci + k) % signatures
+            fut = submit_with_retry(tenant, jobs[sig_idx])
+            if fut is not None:
+                note_done(fut, sig_idx)
+
+    def client_open(ci: int):
+        tenant = f"t{ci % tenants}"
+        period = 1.0 / max(rate_rps / clients, 1e-3)
+        pending = []
+        for k in range(int(requests_per_client)):
+            sig_idx = (ci + k) % signatures
+            fut = submit_with_retry(tenant, jobs[sig_idx])
+            if fut is not None:
+                pending.append((fut, sig_idx))
+            time.sleep(period)
+        for fut, sig_idx in pending:
+            note_done(fut, sig_idx)
+
+    body = client_closed if mode == "closed" else client_open
+    threads = [
+        threading.Thread(target=body, args=(ci,), daemon=True,
+                         name=f"lg-client-{ci}")
+        for ci in range(clients)
+    ]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=300.0)
+    wall_s = time.perf_counter() - t0
+
+    try:
+        fe.close()
+        # exactness: every signature's array must equal its completed
+        # count exactly (each completed request applied +1 once)
+        checked = all(
+            bool(np.all(np.asarray(arrays[s]) == float(completed_per_sig[s])))
+            for s in range(signatures)
+        )
+    finally:
+        cr.dispose()
+
+    completed = sum(completed_per_sig)
+    windows = int(m_windows.value - w0)
+    fused_iters = int(m_iters.value - i0)
+    per_call = max(0, completed - fused_iters)
+    launches = windows + per_call
+    lat_ms = sorted(v * 1000.0 for v in latencies)
+    return {
+        "mode": mode,
+        "clients": clients,
+        "tenants": tenants,
+        "signatures": signatures,
+        "requests_target": total_target,
+        "completed": completed,
+        "failed": failed[0],
+        "rejected": rejected[0],
+        "retries_exhausted": retries_exhausted[0],
+        "wall_s": round(wall_s, 4),
+        "p50_ms": round(_percentile(lat_ms, 0.50), 3),
+        "p99_ms": round(_percentile(lat_ms, 0.99), 3),
+        "goodput_rps": round(completed / wall_s, 2) if wall_s > 0 else None,
+        # the coalescing evidence: ladder dispatches actually paid vs
+        # requests served (windows = fused ladder batches, per_call =
+        # iterations that rode the per-call path)
+        "fused_windows": windows,
+        "fused_iters": fused_iters,
+        "per_call_iters": per_call,
+        "ladder_launches": launches,
+        "coalesce_ratio": (round(completed / launches, 3)
+                           if launches > 0 else None),
+        "coalesced": launches < completed,
+        "checked": checked,
+    }
+
+
+def loadgen_section(devices=None, clients: int = 32, tenants: int = 4,
+                    signatures: int = 4, requests_per_client: int = 8,
+                    rate_rps: float = 400.0) -> dict:
+    """bench.py's ``serving`` section: one closed-loop run (the latency
+    keys) + one open-loop run (the goodput key), with the headline
+    floats hoisted to the top level."""
+    closed = run_loadgen(
+        devices, clients=clients, tenants=tenants, signatures=signatures,
+        requests_per_client=requests_per_client, mode="closed")
+    opened = run_loadgen(
+        devices, clients=clients, tenants=tenants, signatures=signatures,
+        requests_per_client=requests_per_client, mode="open",
+        rate_rps=rate_rps)
+    return {
+        "p50_ms": closed["p50_ms"],
+        "p99_ms": closed["p99_ms"],
+        "goodput_rps": opened["goodput_rps"],
+        "coalesce_ratio": closed["coalesce_ratio"],
+        "coalesced": bool(closed["coalesced"] and opened["coalesced"]),
+        "checked": bool(closed["checked"] and opened["checked"]),
+        "closed": closed,
+        "open": opened,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python tools/loadgen.py",
+        description="serving-tier load generator (docs/SERVING.md)")
+    ap.add_argument("--clients", type=int, default=32)
+    ap.add_argument("--tenants", type=int, default=4)
+    ap.add_argument("--signatures", type=int, default=4)
+    ap.add_argument("--requests", type=int, default=8,
+                    help="requests per client")
+    ap.add_argument("--mode", choices=("closed", "open", "both"),
+                    default="closed")
+    ap.add_argument("--rate", type=float, default=200.0,
+                    help="open-loop aggregate submit rate (rps)")
+    ap.add_argument("--n", type=int, default=1 << 14,
+                    help="work items per job")
+    ap.add_argument("--quota", type=int, default=0,
+                    help="per-tenant in-flight quota (0 = generous)")
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.mode == "both":
+        out = loadgen_section(
+            clients=args.clients, tenants=args.tenants,
+            signatures=args.signatures, requests_per_client=args.requests,
+            rate_rps=args.rate)
+    else:
+        out = run_loadgen(
+            clients=args.clients, tenants=args.tenants,
+            signatures=args.signatures, requests_per_client=args.requests,
+            mode=args.mode, rate_rps=args.rate, n=args.n, quota=args.quota)
+    if args.json:
+        print(json.dumps(_json_safe(out), allow_nan=False))
+        return 0
+    rows = out if args.mode != "both" else {
+        k: v for k, v in out.items() if k not in ("closed", "open")}
+    for k, v in rows.items():
+        print(f"  {k:>20}: {v}")
+    if not out.get("checked", True):
+        print("  EXACTNESS CHECK FAILED", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

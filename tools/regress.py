@@ -86,6 +86,15 @@ WATCHED_KEYS = (
     ("mandelbrot_mpix", (), "higher", 0.10),
     ("vs_tuned_loop", (), "higher", 0.10),
     ("repeat_mode_mpix", (), "higher", 0.10),
+    # serving tier (ISSUE 11, bench section "serving"): closed-loop
+    # latency percentiles (lower is better), open-loop goodput, and
+    # requests-per-ladder-launch coalescing ratio.  Latency floors are
+    # wide: a CPU-container p99 carries the first-compile wall and
+    # scheduler jitter
+    ("serve_p50_ms", (), "lower", 0.30),
+    ("serve_p99_ms", (), "lower", 0.40),
+    ("serve_goodput_rps", (), "higher", 0.25),
+    ("serve_coalesce_ratio", (), "higher", 0.20),
 )
 
 #: Trajectory-noise widening: tolerance = max(floor, NOISE_K * CV).
@@ -104,6 +113,10 @@ KEY_SECTION = {
     "mandelbrot_mpix": "framework",
     "vs_tuned_loop": "tuned_loop",
     "repeat_mode_mpix": "repeat_mode",
+    "serve_p50_ms": "serving",
+    "serve_p99_ms": "serving",
+    "serve_goodput_rps": "serving",
+    "serve_coalesce_ratio": "serving",
 }
 
 
