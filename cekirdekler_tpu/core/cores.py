@@ -34,8 +34,9 @@ from contextlib import ExitStack, nullcontext
 from dataclasses import dataclass, field
 from typing import Any, Sequence
 
+from ..analysis import flag_row
 from ..arrays.clarray import ClArray
-from ..errors import ComputeValidationError
+from ..errors import ComputeValidationError, KernelVerifyError
 from ..hardware import Devices
 from ..kernel.registry import KernelProgram
 from ..metrics.registry import REGISTRY
@@ -286,6 +287,13 @@ class Cores:
         # reporting, not a decision input.
         # ckcheck: ok reporting-only reads; one-slot-per-lane, stale tolerated
         self.last_stream_chunks: dict[int, int] = {}
+        # kernel-verify advisory dedupe, keyed on (kernel sequence,
+        # first finding fingerprint) — NOT object identity: the
+        # program's verdict cache is written lock-free, so a racing
+        # first-verify can hand this method a verdict the cache then
+        # drops, and a recycled id() would suppress a different
+        # shape's one-and-only advisory forever
+        self._verify_notified: set[tuple] = set()
         # per-cid fence splitting (VERDICT r5 #8): when on, barrier()
         # fences each compute id's last output in last-dispatch order and
         # feeds the balancer MARGINAL per-cid times instead of charging
@@ -551,6 +559,24 @@ class Cores:
             # leaving enqueue mode without flush() (callers normally go
             # through the cruncher setter, which flushes)
             self._fused_break("enqueue-off")
+        # kernel partition-safety / flag-soundness gate (analysis/,
+        # docs/STATIC_ANALYSIS.md "Kernel partition-safety"): verdicts
+        # cache per launch shape in the program, so steady state pays
+        # one env read + one dict hit.  Deferred fused calls never
+        # reach this point — the window's engage call already verified
+        # the identical shape.  Advisory by default (one flight event
+        # per shape); CK_KERNEL_VERIFY=strict raises the named finding.
+        verify_mode = os.environ.get("CK_KERNEL_VERIFY", "advisory")
+        if verify_mode != "off":
+            verdict = self.program.verify(
+                tuple(kernel_names),
+                tuple(flag_row(p.flags) for p in params),
+                window=self.enqueue_mode or self.repeat_count > 1,
+            )
+            if verdict.errors:
+                if verify_mode == "strict":
+                    raise KernelVerifyError(verdict.errors[0])
+                self._note_kernel_verdict(verdict, kernel_names)
         if self.enqueue_mode:
             # under the lock: concurrent host threads may drive different
             # compute ids through one Cores, and the order list's
@@ -716,6 +742,22 @@ class Cores:
                 kernel_names, params, compute_id, global_range,
                 local_range, global_offset, value_args, ranges, refs, step,
             )
+
+    def _note_kernel_verdict(self, verdict, kernel_names) -> None:
+        """Advisory-mode surfacing of an unsafe launch shape: one
+        flight event per distinct (kernel sequence, finding) — a
+        value key, stable across racing verdict constructions."""
+        f = verdict.errors[0]
+        key = (tuple(kernel_names), f.fingerprint)
+        with self._lock:
+            if key in self._verify_notified:
+                return
+            self._verify_notified.add(key)
+        FLIGHT.event(
+            "kernel-verify", kernels="+".join(kernel_names),
+            finding=f.kind, kernel=f.kernel, param=f.param, line=f.line,
+            errors=len(verdict.errors),
+        )
 
     def _record_perf(
         self, compute_id: int, t_start: float, ranges: list[int]
@@ -1143,7 +1185,10 @@ class Cores:
     ) -> None:
         gate = self.dispatch_gate
         if gate is not None:
-            gate.wait()  # synchronized start across lanes (ClUserEvent)
+            # ckcheck: ok user-triggered gate — blocking until the
+            # caller fires it IS the ClUserEvent synchronized-start
+            # semantic (reference: Worker.cs:487-557)
+            gate.wait()
         # serialize whole phases per worker: concurrent host threads driving
         # DIFFERENT compute ids through one Cores (the reference's
         # kernelWithId concurrency contract, Worker.cs:291-316) otherwise

@@ -128,7 +128,18 @@ class _DriverQueue:
                 self._errors.clear()
                 raise e
             while self._pending >= max(1, int(depth)):
-                self._cond.wait()
+                # bounded wait + loop re-check: a submit parked on
+                # backpressure must not hang forever if the driver
+                # thread died (pending would then never drain).  The
+                # liveness check applies only while STILL blocked — a
+                # clean close() that drained the backlog and exited
+                # must not be misreported as a thread death
+                self._cond.wait(1.0)
+                if self._pending >= max(1, int(depth)) and \
+                        not self._thread.is_alive():
+                    raise RuntimeError(
+                        f"driver {self.name!r} thread died with "
+                        f"{self._pending} closure(s) pending")
             self._pending += 1
             if self._depth_gauge is not None:
                 self._depth_gauge.set(self._pending)
@@ -136,6 +147,9 @@ class _DriverQueue:
 
     def _run(self) -> None:
         while True:
+            # ckcheck: ok sentinel-terminated daemon loop — close()
+            # always enqueues the None sentinel; an unbounded get IS
+            # the idle state of this thread
             fn = self._q.get()
             if fn is None:
                 return
@@ -177,7 +191,14 @@ class _DriverQueue:
         re-raising the first failure."""
         with self._cond:
             while self._pending > 0:
-                self._cond.wait()
+                # bounded wait + loop re-check: a drain must not hang
+                # shutdown forever if the driver thread died mid-batch
+                # (the pending count would then never reach zero)
+                self._cond.wait(1.0)
+                if self._pending > 0 and not self._thread.is_alive():
+                    raise RuntimeError(
+                        f"driver {self.name!r} thread died with "
+                        f"{self._pending} closure(s) pending")
             if self._errors:
                 e = self._errors[0]
                 self._errors.clear()

@@ -34,6 +34,22 @@ class ComputeValidationError(CekirdeklerError):
     ClParameterGroup validation, ClArray.cs:543-645)."""
 
 
+class KernelVerifyError(ComputeValidationError):
+    """The kernel partition-safety/flag-soundness verifier
+    (``analysis/``) refuted this launch and ``CK_KERNEL_VERIFY=strict``
+    is set.  Carries the first named :class:`~.analysis.Finding` as
+    ``finding`` (kind, kernel, param, source line)."""
+
+    def __init__(self, finding):
+        self.finding = finding
+        super().__init__(
+            f"kernel verifier [{finding.kind}] at kernel source line "
+            f"{finding.line}: {finding.message} (CK_KERNEL_VERIFY=strict; "
+            "fix the kernel/flags or suppress the line with "
+            "`// ckprove: ok <why>`)"
+        )
+
+
 class DeviceSelectionError(CekirdeklerError):
     """No devices matched the query (reference: Cores error strings when no
     devices are found, Cores.cs:186-246)."""

@@ -92,6 +92,14 @@ class KernelProgram:
         self._py_kernels: dict[str, PythonKernel] = {}
         self._cache: dict[tuple, tuple[Callable, Any]] = {}
         self._lock = threading.Lock()
+        # partition-safety/flag-soundness verification (analysis/):
+        # access summaries build once per kernel on first verify();
+        # launch verdicts cache per (names, flag rows, window).  Both
+        # dicts are written lock-free by design — concurrent misses
+        # recompute the same immutable value, and the serve submit hot
+        # path must not grow a lock for a cache read.
+        self._analysis_summaries: dict[str, Any] | None = None
+        self._verdict_cache: dict[tuple, Any] = {}
 
         items: list = []
         if isinstance(source, (str, PythonKernel)):
@@ -150,6 +158,49 @@ class KernelProgram:
         if name in self._c_kernels:
             return [p.name for p in self._c_kernels[name].params if not p.is_pointer]
         return list(self._py_kernels[name].value_params)
+
+    # -- partition-safety verification (analysis/) ---------------------------
+    def summaries(self) -> dict:
+        """Per-kernel access summaries, built once per program (one
+        abstract interpretation per C kernel; Python kernels map to
+        ``None`` — outside the analyzable surface).  An analysis
+        bail-out on one kernel degrades THAT kernel to unverifiable,
+        never breaks the build."""
+        out = self._analysis_summaries
+        if out is None:
+            from .. import analysis
+
+            out = {}
+            for name, kdef in self._c_kernels.items():
+                try:
+                    out[name] = analysis.summarize_kernel(kdef)
+                except Exception:  # noqa: BLE001 - degrade, never break
+                    out[name] = None
+            for name in self._py_kernels:
+                out[name] = None
+            self._analysis_summaries = out
+        return out
+
+    def verify(self, kernel_names, flag_rows, window: bool = False):
+        """Cached :class:`~..analysis.LaunchVerdict` for one launch
+        shape.  ``flag_rows`` is a tuple of
+        :func:`~..analysis.flag_row` tuples (positional, the call's
+        parameter order).  Verification runs once per distinct
+        (kernel sequence, flags, window) — every later call is one
+        dict lookup."""
+        key = (tuple(kernel_names), tuple(flag_rows), bool(window))
+        v = self._verdict_cache.get(key)
+        if v is None:
+            from .. import analysis
+
+            try:
+                v = analysis.verify_launch(
+                    self.summaries(), key[0], key[1], window=key[2])
+            except Exception:  # noqa: BLE001 - verifier must never
+                # sink a compute; an empty verdict is "nothing proven"
+                v = analysis.LaunchVerdict(findings=())
+            self._verdict_cache[key] = v
+        return v
 
     def launcher(
         self,

@@ -88,6 +88,7 @@ EVENT_KINDS = (
     "fused-engage", "fused-disengage", "fused-window",
     "stream-choice", "stream-retune",
     "barrier", "driver-error", "metrics-sample", "crash",
+    "kernel-verify",
     "debug-server", "debug-port-skipped",
     "profiler-start", "profiler-stop",
 )

@@ -306,6 +306,10 @@ def _replay_admission(inp: dict, out: dict) -> dict:
         max_queue_depth=int(inp["max_queue_depth"]),
         healthy=bool(inp["healthy"]),
         est_batch_s=float(inp["est_batch_s"]),
+        # kernel-verifier inputs arrived with the ckprove gate; older
+        # logs lack them — replay with the pre-gate defaults
+        kernel_unsafe=bool(inp.get("kernel_unsafe", False)),
+        kernel_finding=inp.get("kernel_finding"),
     )
     mism: dict = {}
     for k in ("admit", "reason", "retry_after_s"):

@@ -435,6 +435,9 @@ class ClDevicePool:
     def finish(self) -> None:
         """Block until all enqueued pools are fully executed (reference:
         finish, ClPipeline.cs:4433+)."""
+        # ckcheck: ok queue.Queue.join has no timeout form; consumer
+        # threads are daemons dispose() stops, and task_done fires in
+        # their finally — finish() blocking until then is the contract
         self._pools.join()
         self._drain()
         with self._inflight_lock:

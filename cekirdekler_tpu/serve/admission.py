@@ -16,6 +16,11 @@ story ROADMAP item 1 names).
 
 Check order (the contract, pinned by test):
 
+0. **kernel soundness** — a job the kernel partition-safety verifier
+   refuted (``analysis/``; ``CK_KERNEL_VERIFY=strict`` at the
+   frontend) is structurally broken: rejected first, with
+   ``retry_after_s=0.0`` — no backoff makes it admissible, the kernel
+   or its flags must change.
 1. **health** — the lane-health verdict gates the whole tier: with any
    lane degraded (``HealthMonitor.healthy()`` false — the same verdict
    ``/healthz`` serves as 503) nothing is admitted; retry-after backs
@@ -47,6 +52,7 @@ __all__ = [
     "REJECT_HEALTH",
     "REJECT_QUEUE",
     "REJECT_QUOTA",
+    "REJECT_KERNEL",
 ]
 
 #: Named rejection reasons (the ``ck_serve_rejected_total{reason}``
@@ -54,6 +60,10 @@ __all__ = [
 REJECT_HEALTH = "unhealthy"
 REJECT_QUEUE = "queue-depth"
 REJECT_QUOTA = "tenant-quota"
+#: The kernel verifier (``analysis/``; CK_KERNEL_VERIFY=strict)
+#: refuted the job's kernels/flags: a structurally unsafe job — no
+#: retry hint helps, the kernel or its flags must change.
+REJECT_KERNEL = "kernel-unsafe"
 
 #: Floor for retry-after hints: even an instant-drain tier should not
 #: invite a reject/retry busy-loop.
@@ -91,12 +101,22 @@ def admit_decision(
     max_queue_depth: int,
     healthy: bool,
     est_batch_s: float,
+    kernel_unsafe: bool = False,
+    kernel_finding: str | None = None,
 ) -> dict:
     """The PURE admission transition (replay-verified — see module
     docstring for the check order).  Returns ``{"admit", "reason",
     "retry_after_s"}``; ``reason``/``retry_after_s`` are None on
-    admit."""
+    admit.
+
+    ``kernel_unsafe`` is checked FIRST: a job the kernel verifier
+    refuted (``kernel_finding`` names the verdict kind) is structurally
+    broken — no backoff makes it admissible, so ``retry_after_s`` is
+    0.0 (do not retry as-is)."""
     base = max(float(est_batch_s), _RETRY_FLOOR_S)
+    if kernel_unsafe:
+        return {"admit": False, "reason": REJECT_KERNEL,
+                "retry_after_s": 0.0}
     if not healthy:
         # tier-wide gate: back off hardest — a degraded lane needs
         # windows, not more traffic
@@ -175,11 +195,19 @@ class AdmissionController:
         tenant_inflight: int,
         queue_depth: int,
         est_batch_s: float,
+        kernel_unsafe: bool = False,
+        kernel_finding: str | None = None,
     ) -> dict:
         """One admission decision for ``tenant``, recorded with its
         complete inputs (kind ``admission``).  Returns the
         :func:`admit_decision` dict; the caller raises
-        :class:`ServeRejected` / increments its own accounting."""
+        :class:`ServeRejected` / increments its own accounting.
+
+        ``kernel_unsafe``/``kernel_finding`` come from the caller's
+        kernel-verifier gate (``ServeFrontend.submit`` under
+        ``CK_KERNEL_VERIFY=strict``) and enter the decision record as
+        INPUTS, so a ``kernel-unsafe`` rejection replays bit-identically
+        offline — a tenant disputing it is answered from the log."""
         quota = self.quota_of(tenant).max_inflight
         healthy = self.healthy()
         dec = admit_decision(
@@ -187,6 +215,8 @@ class AdmissionController:
             queue_depth=int(queue_depth),
             max_queue_depth=self.max_queue_depth,
             healthy=healthy, est_batch_s=float(est_batch_s),
+            kernel_unsafe=bool(kernel_unsafe),
+            kernel_finding=kernel_finding,
         )
         if DECISIONS.enabled:
             # the complete replay inputs — a rejected tenant's dispute
@@ -199,5 +229,8 @@ class AdmissionController:
                 "max_queue_depth": self.max_queue_depth,
                 "healthy": healthy,
                 "est_batch_s": float(est_batch_s),
+                "kernel_unsafe": bool(kernel_unsafe),
+                "kernel_finding": (None if kernel_finding is None
+                                   else str(kernel_finding)),
             }, dict(dec))
         return dec

@@ -35,6 +35,7 @@ admission configuration.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 import weakref
@@ -42,6 +43,7 @@ from concurrent.futures import Future
 from dataclasses import dataclass, field
 from typing import Sequence
 
+from ..analysis import flag_row
 from ..errors import CekirdeklerError, ComputeValidationError
 from ..metrics.registry import REGISTRY
 from ..obs.decisions import DECISIONS
@@ -212,6 +214,23 @@ class ServeFrontend:
                 "serve jobs need hashable values (array-valued value "
                 "args cannot coalesce)")
         st = self.tenants.state(tenant)
+        # kernel partition-safety gate (analysis/): under strict
+        # verification an unsafe job is refused at the door with the
+        # named verdict kind — the serving tier takes kernels from
+        # untrusted tenants, and a mis-flagged kernel would corrupt
+        # results for everyone sharing the coalesced window.  Verdicts
+        # cache per launch shape in the program, so steady state is
+        # one env read + one dict hit; computed OUTSIDE the frontend
+        # lock (the admit transition must stay short).
+        kernel_finding = None
+        if jb.kernels and \
+                os.environ.get("CK_KERNEL_VERIFY", "advisory") == "strict":
+            v = self.cores.program.verify(
+                tuple(jb.kernels),
+                tuple(flag_row(p.flags) for p in jb.params),
+                window=True)
+            if v.errors:
+                kernel_finding = v.errors[0]
         fut: Future = Future()
         with self._mu:
             if self._halt:
@@ -223,7 +242,10 @@ class ServeFrontend:
                     f"frontend {self.name!r} is closed")
             inflight = self.tenants.note_request(st)
             dec = self.admission.check(
-                tenant, inflight, self._pending, self._est_batch_s)
+                tenant, inflight, self._pending, self._est_batch_s,
+                kernel_unsafe=kernel_finding is not None,
+                kernel_finding=(kernel_finding.kind
+                                if kernel_finding else None))
             if dec["admit"]:
                 self.tenants.note_admitted(st)
                 g = self._groups.get(sig)

@@ -141,6 +141,9 @@ class MarkerCounter:
         # (native terminate).  The whole batch retires as ONE weighted
         # rate sample (see below).
         while True:
+            # ckcheck: ok sentinel-terminated daemon loop — close()
+            # always enqueues the None sentinel; the unbounded get is
+            # this thread's idle state
             item = self._completions.get()
             if item is None:
                 return

@@ -1638,7 +1638,13 @@ def marker_overhead(n: int = 4096, dispatches: int = 200) -> dict:
     }
     """
     devs = all_devices().tpus() or all_devices().cpus().subset(1)
-    x = ClArray(np.arange(n, dtype=np.float32), name="mx", read_only=True)
+    # ckprove flag fix (partial-safe advisory): the light kernel reads
+    # x only at [i], so each lane needs only its slice — the old full
+    # read paid whole-array H2D per lane per dispatch in a benchmark
+    # whose entire point is per-dispatch cost.  Bit-identity with the
+    # full read is pinned by test_partial_read_fix_is_bit_identical.
+    x = ClArray(np.arange(n, dtype=np.float32), name="mx",
+                partial_read=True, read_only=True)
     y = ClArray(n, np.float32, name="my", partial_read=True)
     cr = NumberCruncher(devs, src)
     out: dict = {"dispatches": dispatches}

@@ -1,0 +1,27 @@
+"""Planted unbounded-blocking shapes (ckcheck pass 5): a worker loop
+and a shutdown path that block forever when their counterpart thread
+died — the serve-dispatcher / driver-queue shutdown-hang hazard."""
+
+import queue
+import threading
+
+
+class Pump:
+    def __init__(self):
+        self._q = queue.Queue()
+        self._cond = threading.Condition()
+        self._thread = threading.Thread(target=self.run, daemon=True)
+        self._thread.start()
+
+    def run(self):
+        while True:
+            item = self._q.get()  # blocks forever without a sentinel
+            if item is None:
+                return
+
+    def wait_idle(self):
+        with self._cond:
+            self._cond.wait()  # no timeout: hangs if run() died
+
+    def shutdown(self):
+        self._thread.join()  # no timeout: hangs on a stuck run()

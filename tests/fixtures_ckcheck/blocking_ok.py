@@ -1,0 +1,31 @@
+"""Clean twin of blocking_bad: bounded waits with loop re-checks, and
+an annotated sentinel-terminated daemon loop."""
+
+import queue
+import threading
+
+
+class Pump:
+    def __init__(self):
+        self._q = queue.Queue()
+        self._cond = threading.Condition()
+        self._idle = False
+        self._thread = threading.Thread(target=self.run, daemon=True)
+        self._thread.start()
+
+    def run(self):
+        while True:
+            # ckcheck: ok sentinel-terminated daemon loop — shutdown()
+            # always enqueues the None sentinel
+            item = self._q.get()
+            if item is None:
+                return
+
+    def wait_idle(self):
+        with self._cond:
+            while not self._idle:
+                self._cond.wait(1.0)
+
+    def shutdown(self):
+        self._q.put(None)
+        self._thread.join(timeout=5.0)

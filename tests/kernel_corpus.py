@@ -1,0 +1,457 @@
+"""Differential-oracle corpus for the kernel partition-safety verifier.
+
+Two halves:
+
+1. **The corpus** (:data:`CORPUS`): ≥20 kernels with declared
+   :class:`TransferFlags` per array — a dozen safe shapes covering the
+   supported surface (elementwise, uniform gathers under full reads,
+   stencils under full reads, helpers, branches, private arrays,
+   epw>1, covered write-only), and ≥8 deliberately unsafe shapes (halo
+   and gathered reads under ``partial_read``, scatter and shifted
+   writes, read-before-write under ``write_only``, a cross-kernel
+   window RAW hazard, a clipped ``write_all``, a uniform-index write).
+   Each entry names the error kinds ``analysis.verify_launch`` must
+   emit (empty = must be clean).
+
+2. **The differential oracle** (:func:`run_lanes` / :func:`run_pure` /
+   :func:`ground_truth_unsafe`): a flag-faithful lane simulator built
+   on the scalar reference interpreter (``tests/kernel_oracle.py`` —
+   itself differentially fuzzed against both compiled lowerings).  It
+   stages device buffers per lane exactly like ``Worker.upload``
+   (full copy for full reads; the lane's slice over zeros for
+   ``partial_read``; zeros for never-uploaded arrays), runs the kernel
+   sequence per lane over its range, and writes back each lane's slice
+   (or the owner's whole array under ``write_all``) exactly like the
+   flush path.  **Ground truth**: a (kernels, flags) launch is unsafe
+   iff a ≥2-lane split differs bit-exactly from the unsplit run, or
+   the unsplit run differs from the pure language semantics (all
+   arrays visible — the flag-lie detector for ``write_only``
+   read-before-write).
+
+tools/ckprove's corpus scan deliberately excludes ``tests/`` — the
+unsafe kernels here are planted on purpose.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import zlib
+from dataclasses import dataclass, field
+
+import numpy as np
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+ROOT = os.path.dirname(HERE)
+if ROOT not in sys.path:
+    sys.path.insert(0, ROOT)
+
+from cekirdekler_tpu.arrays.clarray import TransferFlags  # noqa: E402
+from cekirdekler_tpu.kernel import lang  # noqa: E402
+from tests.kernel_oracle import Oracle  # noqa: E402
+
+
+@dataclass(frozen=True)
+class CorpusKernel:
+    """One corpus entry: kernels + flags + the expected error kinds."""
+
+    name: str
+    source: str
+    flags: tuple                 # TransferFlags kwargs per call param
+    expect: tuple = ()           # expected ERROR kinds (empty = safe)
+    values: tuple = ()           # positional scalar args (all kernels)
+    global_range: int = 192
+    local_range: int = 32
+    iters: int = 1               # window iterations (enqueue semantics)
+    window: bool = False         # verdict treats the sequence as cyclic
+    init: dict = field(default_factory=dict)   # pos -> fn(rng, n) -> arr
+    sizes: tuple | None = None   # per-param element counts
+
+
+# ---------------------------------------------------------------------------
+# the flag-faithful lane simulator
+# ---------------------------------------------------------------------------
+
+def _split(global_range: int, lanes: int, step: int):
+    """Equal split in step quanta (offsets, sizes) — the first-call
+    split shape; WHICH equal split is irrelevant to the oracle (it
+    compares split vs unsplit of the same simulator)."""
+    units = global_range // step
+    base, rem = divmod(units, lanes)
+    sizes = [(base + (1 if i < rem else 0)) * step for i in range(lanes)]
+    offs, acc = [], 0
+    for s in sizes:
+        offs.append(acc)
+        acc += s
+    return offs, sizes
+
+
+def _vals_for(kdef, values):
+    names = [p.name for p in kdef.params if not p.is_pointer]
+    if isinstance(values, dict):
+        vals = values.get(kdef.name, ())
+    else:
+        vals = tuple(values)
+    return dict(zip(names, vals))
+
+
+def _bind_arrays(kdef, bufs):
+    pnames = [p.name for p in kdef.params if p.is_pointer]
+    return {name: bufs[j] for j, name in enumerate(pnames)}
+
+
+def run_lanes(
+    kdefs, host_arrays, flags, values, global_range, local_range,
+    lanes, iters=1,
+):
+    """Simulate the staged/split/write-back machine semantics on
+    ``lanes`` virtual lanes; returns the final host arrays (copies)."""
+    host = [np.array(a, copy=True) for a in host_arrays]
+    offs, sizes = _split(global_range, lanes, local_range)
+    active = [i for i in range(lanes) if sizes[i] > 0]
+    single = len(active) == 1
+    # stage per-lane device buffers (Worker.upload semantics)
+    dev: list[list[np.ndarray]] = []
+    for li in range(lanes):
+        bufs = []
+        for a, fl in zip(host, flags):
+            epw = fl.elements_per_work_item
+            if fl.read and not fl.write_only:
+                if single or not fl.partial_read:
+                    bufs.append(a.copy())
+                else:
+                    b = np.zeros_like(a)
+                    s = slice(offs[li] * epw, (offs[li] + sizes[li]) * epw)
+                    b[s] = a[s]
+                    bufs.append(b)
+            else:
+                bufs.append(np.zeros_like(a))  # ensure_resident: zeros
+        dev.append(bufs)
+    # run the window per lane (kernel-major, like Worker.launch)
+    for li in active:
+        for _ in range(iters):
+            for kdef in kdefs:
+                oracle = Oracle(kdef, local_size=local_range)
+                arrays = _bind_arrays(kdef, dev[li])
+                vals = _vals_for(kdef, values)
+                for gid in range(offs[li], offs[li] + sizes[li]):
+                    oracle._run_item(gid, arrays, vals, global_range)
+    # write back (flush semantics): slices per lane, whole from the
+    # write_all owner ("device i writes array (i mod numDevices)")
+    owner = {
+        idx: active[idx % len(active)]
+        for idx, fl in enumerate(flags) if fl.write_all
+    } if active else {}
+    for idx, (a, fl) in enumerate(zip(host, flags)):
+        if fl.write and not fl.read_only:
+            if fl.write_all:
+                a[:] = dev[owner[idx]][idx]
+            else:
+                epw = fl.elements_per_work_item
+                for li in active:
+                    s = slice(offs[li] * epw, (offs[li] + sizes[li]) * epw)
+                    a[s] = dev[li][idx][s]
+    return host
+
+
+def run_pure(kdefs, host_arrays, values, global_range, local_range,
+             iters=1):
+    """The language's own semantics: every array fully visible, every
+    store lands — what the kernel MEANS, flags aside."""
+    host = [np.array(a, copy=True) for a in host_arrays]
+    for _ in range(iters):
+        for kdef in kdefs:
+            oracle = Oracle(kdef, local_size=local_range)
+            arrays = _bind_arrays(kdef, host)
+            vals = _vals_for(kdef, values)
+            for gid in range(global_range):
+                oracle._run_item(gid, arrays, vals, global_range)
+    return host
+
+
+def build(entry: CorpusKernel):
+    """``(kdefs, flags_objs, host_arrays)`` for one corpus entry —
+    deterministic per entry name."""
+    kdefs = lang.parse_kernels(entry.source)
+    flags = []
+    for kw in entry.flags:
+        f = TransferFlags(**kw)
+        f.validate()
+        flags.append(f)
+    rng = np.random.default_rng(zlib.crc32(entry.name.encode()))
+    host = []
+    for pos, fl in enumerate(flags):
+        n = (entry.sizes[pos] if entry.sizes is not None
+             else entry.global_range * fl.elements_per_work_item)
+        if pos in entry.init:
+            host.append(np.asarray(entry.init[pos](rng, n), np.float32))
+        else:
+            # nonzero everywhere: a staged-zero leaking into a result
+            # must CHANGE it, never coincide
+            host.append(
+                rng.uniform(0.5, 1.5, n).astype(np.float32))
+    return kdefs, flags, host
+
+
+def ground_truth_unsafe(entry: CorpusKernel, lanes: int = 2) -> bool:
+    """True iff the differential oracle refutes the launch: the
+    ``lanes``-way split differs from unsplit, or unsplit differs from
+    the pure semantics (see module doc)."""
+    kdefs, flags, host = build(entry)
+    args = (kdefs, host, entry.values, entry.global_range,
+            entry.local_range)
+    pure = run_pure(*args, iters=entry.iters)
+    unsplit = run_lanes(
+        kdefs, host, flags, entry.values, entry.global_range,
+        entry.local_range, lanes=1, iters=entry.iters)
+    split = run_lanes(
+        kdefs, host, flags, entry.values, entry.global_range,
+        entry.local_range, lanes=lanes, iters=entry.iters)
+    for p, u, s in zip(pure, unsplit, split):
+        if not (np.array_equal(u, s) and np.array_equal(p, u)):
+            return True
+    return False
+
+
+def verdict_for(entry: CorpusKernel):
+    """The verifier's launch verdict for one entry."""
+    from cekirdekler_tpu import analysis
+
+    kdefs, flags, _host = build(entry)
+    sums = {k.name: analysis.summarize_kernel(k) for k in kdefs}
+    rows = tuple(analysis.flag_row(f) for f in flags)
+    return analysis.verify_launch(
+        sums, tuple(k.name for k in kdefs), rows, window=entry.window,
+        where=f"corpus:{entry.name}")
+
+
+# ---------------------------------------------------------------------------
+# the corpus
+# ---------------------------------------------------------------------------
+
+def _rev_idx(rng, n):
+    return np.arange(n - 1, -1, -1, dtype=np.float32)
+
+
+def _cross_idx(rng, n):
+    return ((np.arange(n) + n // 2) % n).astype(np.float32)
+
+
+CORPUS = (
+    # -- safe: the supported surface -------------------------------------
+    CorpusKernel(
+        "saxpy", """
+__kernel void saxpy(__global float* x, __global float* y, float a) {
+    int i = get_global_id(0);
+    y[i] = a * x[i] + y[i];
+}""", (dict(partial_read=True, read_only=True), dict(partial_read=True)),
+        values=(1.5,)),
+    CorpusKernel(
+        "vadd_wo", """
+__kernel void vadd(__global float* a, __global float* b, __global float* c) {
+    int i = get_global_id(0);
+    c[i] = a[i] + b[i];
+}""", (dict(partial_read=True, read_only=True),
+       dict(partial_read=True, read_only=True), dict(write_only=True))),
+    CorpusKernel(
+        "escape_loop", """
+__kernel void esc(__global float* cx, __global float* out, int maxIter) {
+    int i = get_global_id(0);
+    float z = 0.0f;
+    int it = 0;
+    while (z < 4.0f && it < maxIter) {
+        z = z * z + cx[i];
+        it++;
+    }
+    out[i] = (float)it;
+}""", (dict(partial_read=True, read_only=True),
+       dict(read=False, write=True)), values=(12,)),
+    CorpusKernel(
+        "gather_full", """
+__kernel void nb(__global float* x, __global float* v, int n, float dt) {
+    int i = get_global_id(0);
+    float acc = 0.0f;
+    for (int j = 0; j < n; j++) {
+        acc = acc + x[j] - x[i];
+    }
+    v[i] = v[i] + acc * dt;
+}""", (dict(read_only=True), dict(partial_read=True)),
+        values=(192, 0.25), global_range=192),
+    CorpusKernel(
+        "stencil_full", """
+__kernel void st(__global float* p, __global float* out) {
+    int i = get_global_id(0);
+    out[i] = p[i-1] + 2.0f*p[i] + p[i+1];
+}""", (dict(read_only=True), dict(write_only=True))),
+    CorpusKernel(
+        "helper_safe", """
+float sq(float v) {
+    float w = v * v;
+    return w;
+}
+__kernel void hs(__global float* x, __global float* y) {
+    int i = get_global_id(0);
+    y[i] = sq(x[i]) + sq(2.0f);
+}""", (dict(partial_read=True, read_only=True),
+       dict(partial_read=True, write_only=True))),
+    CorpusKernel(
+        "branch_safe", """
+__kernel void br(__global float* x, __global float* y) {
+    int i = get_global_id(0);
+    if (x[i] > 1.0f) {
+        y[i] = x[i] * 2.0f;
+    } else {
+        y[i] = x[i] + 0.5f;
+    }
+}""", (dict(partial_read=True, read_only=True),
+       dict(partial_read=True, write_only=True))),
+    CorpusKernel(
+        "private_array", """
+__kernel void pa(__global float* x, __global float* y) {
+    int i = get_global_id(0);
+    float acc[4];
+    for (int k = 0; k < 4; k++) { acc[k] = x[i] * (float)k; }
+    y[i] = acc[0] + acc[1] + acc[2] + acc[3];
+}""", (dict(partial_read=True, read_only=True),
+       dict(partial_read=True, write_only=True))),
+    CorpusKernel(
+        "epw2", """
+__kernel void e2(__global float* x, __global float* y) {
+    int i = get_global_id(0);
+    y[2*i] = x[2*i] + x[2*i+1];
+    y[2*i+1] = x[2*i] - x[2*i+1];
+}""", (dict(partial_read=True, read_only=True, elements_per_work_item=2),
+       dict(partial_read=True, write_only=True, elements_per_work_item=2)),
+        global_range=96),
+    CorpusKernel(
+        "do_while_safe", """
+__kernel void dw(__global float* x, __global float* y, int reps) {
+    int i = get_global_id(0);
+    float acc = x[i];
+    int k = 0;
+    do {
+        acc = acc * 0.5f + 0.25f;
+        k++;
+    } while (k < reps);
+    y[i] = acc;
+}""", (dict(partial_read=True, read_only=True),
+       dict(partial_read=True, write_only=True)), values=(5,)),
+    CorpusKernel(
+        "wo_covered", """
+__kernel void cov(__global float* a, __global float* c) {
+    int i = get_global_id(0);
+    c[i] = 0.0f;
+    c[i] += a[i];
+    c[i] += a[i] * 0.5f;
+}""", (dict(partial_read=True, read_only=True), dict(write_only=True))),
+    CorpusKernel(
+        "seq_safe", """
+__kernel void stage1(__global float* a, __global float* t, __global float* b) {
+    int i = get_global_id(0);
+    t[i] = a[i] * 2.0f;
+}
+__kernel void stage2(__global float* a, __global float* t, __global float* b) {
+    int i = get_global_id(0);
+    b[i] = t[i] + 1.0f;
+}""", (dict(partial_read=True, read_only=True), dict(partial_read=True),
+       dict(partial_read=True)), iters=2, window=True),
+    CorpusKernel(
+        "const_branch", """
+__kernel void cb(__global float* x, __global float* y) {
+    int i = get_global_id(0);
+    if (i == 0) {
+        y[i] = x[i];
+    } else {
+        y[i] = x[i] * 3.0f;
+    }
+}""", (dict(partial_read=True, read_only=True),
+       dict(partial_read=True, write_only=True))),
+
+    # -- unsafe: each caught with a named finding ------------------------
+    CorpusKernel(
+        "halo_partial", """
+__kernel void sh(__global float* x, __global float* y) {
+    int i = get_global_id(0);
+    y[i] = x[i+1] + x[i];
+}""", (dict(partial_read=True, read_only=True),
+       dict(partial_read=True, write_only=True)),
+        expect=("partial-read-halo",)),
+    CorpusKernel(
+        "halo_neg", """
+__kernel void shn(__global float* x, __global float* y) {
+    int i = get_global_id(0);
+    y[i] = x[i] - x[i-1];
+}""", (dict(partial_read=True, read_only=True),
+       dict(partial_read=True, write_only=True)),
+        expect=("partial-read-halo",)),
+    CorpusKernel(
+        "gather_partial", """
+__kernel void gp(__global float* x, __global float* v, int n) {
+    int i = get_global_id(0);
+    float acc = 0.0f;
+    for (int j = 0; j < n; j++) { acc = acc + x[j]; }
+    v[i] = acc;
+}""", (dict(partial_read=True, read_only=True),
+       dict(partial_read=True, write_only=True)),
+        values=(192,), expect=("partial-read-gather",)),
+    CorpusKernel(
+        "indirect_read", """
+__kernel void ir(__global float* idx, __global float* x, __global float* y) {
+    int i = get_global_id(0);
+    y[i] = x[(int)idx[i]];
+}""", (dict(partial_read=True, read_only=True),
+       dict(partial_read=True, read_only=True),
+       dict(partial_read=True, write_only=True)),
+        init={0: _cross_idx}, expect=("partial-read-gather",)),
+    CorpusKernel(
+        "scatter_write", """
+__kernel void sw(__global float* idx, __global float* x, __global float* out) {
+    int i = get_global_id(0);
+    out[(int)idx[i]] = x[i];
+}""", (dict(partial_read=True, read_only=True),
+       dict(partial_read=True, read_only=True), dict(write_only=True)),
+        init={0: _rev_idx}, expect=("scatter-write",)),
+    CorpusKernel(
+        "shift_write", """
+__kernel void shw(__global float* x, __global float* out) {
+    int i = get_global_id(0);
+    out[i+1] = x[i];
+}""", (dict(partial_read=True, read_only=True), dict(write_only=True)),
+        expect=("off-partition-write",)),
+    CorpusKernel(
+        "uniform_write", """
+__kernel void uw(__global float* x, __global float* out) {
+    int i = get_global_id(0);
+    out[5] = x[i];
+}""", (dict(partial_read=True, read_only=True), dict(write_only=True)),
+        expect=("off-partition-write",)),
+    CorpusKernel(
+        "wo_rbw", """
+__kernel void rbw(__global float* a, __global float* c) {
+    int i = get_global_id(0);
+    c[i] = c[i] * 0.5f + a[i];
+}""", (dict(partial_read=True, read_only=True), dict(write_only=True)),
+        expect=("write-only-read",)),
+    CorpusKernel(
+        "window_raw", """
+__kernel void wrA(__global float* p, __global float* q, __global float* s) {
+    int i = get_global_id(0);
+    p[i] = p[i] + q[i];
+}
+__kernel void wrB(__global float* p, __global float* q, __global float* s) {
+    int i = get_global_id(0);
+    s[i] = s[i] + p[i+1];
+}""", (dict(), dict(partial_read=True, read_only=True),
+       dict(partial_read=True)),
+        iters=2, window=True, expect=("window-raw",)),
+    CorpusKernel(
+        "write_all_clipped", """
+__kernel void wac(__global float* x, __global float* y) {
+    int i = get_global_id(0);
+    y[i] = x[i] * 2.0f;
+}""", (dict(partial_read=True, read_only=True), dict(write_all=True)),
+        expect=("write-all-clipped",)),
+)
+
+SAFE = tuple(e for e in CORPUS if not e.expect)
+UNSAFE = tuple(e for e in CORPUS if e.expect)

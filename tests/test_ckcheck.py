@@ -113,6 +113,41 @@ def test_fixture_order_cycle_found():
     assert not any("cycle_ok" in f.subject for f in found), found
 
 
+def test_fixture_unbounded_blocking_found():
+    """Pass 5: the zero-arg get()/wait()/join() shutdown-hang shapes
+    are FOUND in blocking_bad; the bounded/annotated twin is silent."""
+    found = _by_rule(_fixture_findings(), "unbounded-blocking")
+    methods = {(f.path.rsplit("/", 1)[-1], f.subject.rsplit(":", 1)[-1])
+               for f in found}
+    assert ("blocking_bad.py", "get") in methods, found
+    assert ("blocking_bad.py", "wait") in methods, found
+    assert ("blocking_bad.py", "join") in methods, found
+    assert not any("blocking_ok" in f.path for f in found), found
+
+
+def test_blocking_skips_bounded_and_operand_calls(tmp_path):
+    """str.join(parts) / dict.get(key) / wait(timeout) carry operands
+    or bounds — never findings (the rule is the ZERO-arg form)."""
+    (tmp_path / "mod.py").write_text(
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._cond = threading.Condition()\n"
+        "        self._t = threading.Thread(target=min)\n"
+        "        self._t.start()\n"
+        "    def go(self, d, parts):\n"
+        "        s = ' '.join(parts)\n"
+        "        v = d.get('k')\n"
+        "        with self._cond:\n"
+        "            self._cond.wait(0.5)\n"
+        "        self._t.join(timeout=1.0)\n"
+        "        return s, v\n")
+    pkg = scan_package(str(tmp_path), pkg_name="fx",
+                       repo_root=str(tmp_path))
+    findings = run_passes(pkg, AnalyzerConfig())
+    assert not _by_rule(findings, "unbounded-blocking"), findings
+
+
 def test_fixture_invariants_found():
     findings = _fixture_findings()
     ju = _by_rule(findings, "json-unsafe")

@@ -1,0 +1,128 @@
+"""Property fuzz: random gid-affine kernels through the real parser,
+every verdict checked both ways against the differential oracle.
+
+The generator emits kernels from the gid-affine family the verifier
+models *exactly* — linear accumulations of shifted reads
+(``x[i + d]``, d ∈ [-2, 2]) behind always-taken branches and
+constant-bound loops, written to ``y[i + dw]`` — under random flag
+assignments (partial/full reads, write_only, occasional write_all).
+Construction guarantees divergence is *visible* whenever it is
+possible: every array is initialized strictly positive, every term
+adds with a positive coefficient, so a staged zero leaking into a
+boundary item always changes the result.
+
+For every sample the assertion is bidirectional:
+
+- verdict **safe** → the split-vs-unsplit oracle is bit-identical
+  (zero false negatives by construction);
+- oracle **diverges** → the verdict names an error (the same
+  property, stated from the oracle's side).
+"""
+
+import os
+import sys
+
+import numpy as np
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+ROOT = os.path.dirname(HERE)
+if ROOT not in sys.path:
+    sys.path.insert(0, ROOT)
+
+from tests.kernel_corpus import (  # noqa: E402
+    CorpusKernel,
+    ground_truth_unsafe,
+    verdict_for,
+)
+
+N_SAMPLES = 60
+GLOBAL_RANGE = 96
+LOCAL_RANGE = 8
+
+
+def _gen_kernel(rng) -> CorpusKernel:
+    def c(lo=0.5, hi=1.5):
+        return round(float(rng.uniform(lo, hi)), 3)
+
+    def d():
+        # weighted toward 0 so BOTH sides of the property are
+        # exercised: all-offsets-hot would make nearly every sample
+        # unsafe under a partial flag
+        return 0 if rng.random() < 0.6 else int(rng.integers(-2, 3))
+
+    d0, d1, d2, d3 = d(), d(), d(), d()
+    dw = 0 if rng.random() < 0.75 else int(rng.integers(-1, 2))
+    use_branch = bool(rng.integers(0, 2))
+    use_loop = bool(rng.integers(0, 2))
+    k_iters = int(rng.integers(1, 4))
+
+    def idx(delta):
+        return f"i{'+' if delta >= 0 else '-'}{abs(delta)}" \
+            if delta else "i"
+
+    lines = [
+        "__kernel void fz(__global float* x0, __global float* x1, "
+        "__global float* y) {",
+        "    int i = get_global_id(0);",
+        f"    float t = {c()}f;",
+        f"    t = t + {c()}f * x0[{idx(d0)}];",
+        f"    t = t + {c()}f * x1[{idx(d1)}];",
+    ]
+    if use_branch:
+        # t >= 0.5 by construction, so the branch is ALWAYS taken —
+        # the generated read genuinely executes (a dead halo read
+        # would be a deliberate false positive, out of family)
+        lines += [
+            "    if (t > 0.1f) {",
+            f"        t = t + {c()}f * x0[{idx(d2)}];",
+            "    }",
+        ]
+    if use_loop:
+        lines += [
+            f"    for (int k = 0; k < {k_iters}; k++) " + "{",
+            f"        t = t + x1[{idx(d3)}] * {c()}f;",
+            "    }",
+        ]
+    lines += [f"    y[{idx(dw)}] = t;", "}"]
+
+    x0_partial = bool(rng.integers(0, 2))
+    x1_partial = bool(rng.integers(0, 2))
+    y_wo = bool(rng.integers(0, 2))
+    y_wa = rng.integers(0, 8) == 0  # occasional write_all
+    y_flags = dict(write_all=True) if y_wa else (
+        dict(write_only=True) if y_wo else dict(partial_read=True))
+    return CorpusKernel(
+        name=f"fuzz-{rng.integers(1 << 30)}",
+        source="\n".join(lines),
+        flags=(
+            dict(partial_read=x0_partial, read_only=True),
+            dict(partial_read=x1_partial, read_only=True),
+            y_flags,
+        ),
+        global_range=GLOBAL_RANGE,
+        local_range=LOCAL_RANGE,
+    )
+
+
+def test_fuzz_safe_verdicts_confirmed_by_oracle():
+    rng = np.random.default_rng(0xCEC1)
+    n_safe = n_unsafe = 0
+    for _ in range(N_SAMPLES):
+        entry = _gen_kernel(rng)
+        v = verdict_for(entry)
+        unsafe = any(
+            ground_truth_unsafe(entry, lanes=lanes) for lanes in (2, 3))
+        if v.ok:
+            n_safe += 1
+            assert not unsafe, (
+                f"FALSE NEGATIVE (fuzz): verdict safe but oracle "
+                f"diverges\n{entry.source}\nflags={entry.flags}")
+        else:
+            n_unsafe += 1
+            assert unsafe, (
+                f"error-severity false positive (fuzz): verdict "
+                f"{[f.kind for f in v.errors]} but oracle is "
+                f"bit-identical\n{entry.source}\nflags={entry.flags}")
+    # the generator must actually exercise both sides
+    assert n_safe >= 5, f"degenerate fuzz run: only {n_safe} safe"
+    assert n_unsafe >= 5, f"degenerate fuzz run: only {n_unsafe} unsafe"

@@ -175,6 +175,14 @@ RULE_DOCS = {
         "export.  Route the payload through "
         "cekirdekler_tpu.utils.jsonsafe.json_safe(...) or pass "
         "allow_nan=False (fail loudly, never emit invalid JSON)."),
+    "unbounded-blocking": (
+        "A zero-argument .join()/.wait()/.get() blocks FOREVER when "
+        "its counterpart thread died or its sentinel never arrives — "
+        "the shutdown-hang shape (a serve dispatcher or driver queue "
+        "stuck in close()).  Fix: pass a timeout and re-check the "
+        "predicate in a loop, or annotate `# ckcheck: ok <why>` when "
+        "unbounded blocking IS the design (sentinel-terminated daemon "
+        "loops, user-triggered gates)."),
     "syntax-error": "The file does not parse; nothing in it was analyzed.",
 }
 

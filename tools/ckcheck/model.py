@@ -29,8 +29,8 @@ What one scan produces (:class:`Package`):
 - **Per-function flow events** — lock acquisitions with the locally
   held set at each point, call sites with targets + held set, ``self``
   attribute reads/writes, registry get-or-create calls, telemetry
-  calls, ``json.dumps`` sites: everything the four passes need, from
-  ONE walk per function.
+  calls, ``json.dumps`` sites, zero-arg blocking calls: everything
+  the five passes need, from ONE walk per function.
 
 Suppression vocabulary (trailing comments, same line or the line
 above)::
@@ -188,6 +188,19 @@ class JsonDumpCall:
 
 
 @dataclass
+class BlockingCall:
+    """A zero-argument ``.join()`` / ``.wait()`` / ``.get()`` call —
+    the unbounded-blocking shapes (Thread.join, Condition/Event.wait,
+    Queue.get) that hang shutdown when the counterpart thread died.
+    Any argument bounds the wait (a timeout) or marks a non-blocking
+    receiver (``str.join(parts)``, ``dict.get(key)``), so only the
+    bare form is recorded."""
+
+    method: str           # join | wait | get
+    line: int
+
+
+@dataclass
 class SubscriptAssign:
     base: str             # name of the subscripted variable
     key: str | None       # literal string key when present
@@ -211,6 +224,7 @@ class FuncInfo:
     registry_calls: list = field(default_factory=list)
     telemetry_calls: list = field(default_factory=list)
     json_calls: list = field(default_factory=list)
+    blocking_calls: list = field(default_factory=list)
     subscript_assigns: list = field(default_factory=list)
     dict_literal_headline: list = field(default_factory=list)  # bad lines
 
@@ -1079,6 +1093,13 @@ class _FuncWalker:
             self.fi.telemetry_calls.append(TelemetryCall(
                 api=tel[0], method=tel[1], kind=tel[2], line=node.lineno,
                 computed_args=computed, enabled_guarded=enabled_guard))
+        # zero-arg blocking primitives: join()/wait()/get() with no
+        # timeout and no operands (pass 5, unbounded-blocking)
+        if isinstance(fn, ast.Attribute) and \
+                fn.attr in ("join", "wait", "get") and \
+                not node.args and not node.keywords:
+            self.fi.blocking_calls.append(
+                BlockingCall(method=fn.attr, line=node.lineno))
         # json.dumps / json.dump
         if isinstance(fn, ast.Attribute) and fn.attr in ("dumps", "dump") \
                 and isinstance(fn.value, ast.Name) and fn.value.id == "json":

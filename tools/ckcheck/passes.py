@@ -1,4 +1,4 @@
-"""The four ckcheck passes over a scanned :class:`~.model.Package`.
+"""The five ckcheck passes over a scanned :class:`~.model.Package`.
 
 1. **lock-order** — build the acquisition-order graph (edge ``A → B``
    when ``B`` is acquired while ``A`` is held, interprocedurally), flag
@@ -15,6 +15,9 @@
 4. **invariant** — artifact writers keep ``headline`` last; emitted
    span/flight/decision kinds are declared in their vocabulary tuples;
    ``json.dumps`` on export paths is Infinity/NaN-safe.
+5. **blocking** — zero-argument ``join()``/``wait()``/``get()`` calls
+   (unbounded blocking: the shutdown-hang shape) must carry a timeout
+   or a ``# ckcheck: ok`` annotation naming the design.
 
 Each pass returns ``list[Finding]``; suppression comments
 (``# ckcheck: ok`` / ``guarded-by`` / ``cold``) are honored here.
@@ -47,7 +50,8 @@ class AnalyzerConfig:
     event_vocab: tuple | None = None    # ("obs.flight", "EVENT_KINDS")
     decision_vocab: tuple | None = None  # ("obs.decisions", "DECISION_KINDS")
     # passes to run (all by default)
-    passes: tuple = ("lock-order", "lockset", "hotpath", "invariant")
+    passes: tuple = ("lock-order", "lockset", "hotpath", "invariant",
+                     "blocking")
 
 
 # ---------------------------------------------------------------------------
@@ -440,6 +444,38 @@ def pass_invariant(pkg: Package, cfg: AnalyzerConfig) -> list:
 
 
 # ---------------------------------------------------------------------------
+# pass 5: unbounded blocking
+# ---------------------------------------------------------------------------
+
+def pass_blocking(pkg: Package) -> list:
+    """Zero-argument ``Thread.join()`` / ``Condition.wait()`` /
+    ``Queue.get()`` waits forever when its counterpart thread died —
+    the serve dispatcher and the per-device driver queues are
+    shutdown-hang hazards of exactly this shape.  Every such site must
+    carry a timeout (re-check the predicate in a loop) or a
+    ``# ckcheck: ok <why>`` annotation naming why unbounded blocking
+    is the design (sentinel-terminated daemon loops, user-triggered
+    gates)."""
+    findings: list = []
+    for q, fi in sorted(pkg.functions.items()):
+        mod = pkg.modules.get(fi.module)
+        for bc in fi.blocking_calls:
+            if mod and mod.suppressed(bc.line):
+                continue
+            findings.append(Finding(
+                pass_id="blocking", rule="unbounded-blocking",
+                path=fi.path, line=bc.line,
+                subject=f"{q}:{bc.method}",
+                message=(
+                    f"{q} calls .{bc.method}() with no timeout — blocks "
+                    "forever if the counterpart thread died (shutdown-"
+                    "hang hazard); pass a timeout and re-check in a "
+                    "loop, or annotate `# ckcheck: ok <why>`"),
+            ))
+    return findings
+
+
+# ---------------------------------------------------------------------------
 
 def run_passes(pkg: Package, cfg: AnalyzerConfig) -> list:
     findings: list = []
@@ -458,6 +494,9 @@ def run_passes(pkg: Package, cfg: AnalyzerConfig) -> list:
         findings.extend(pass_hotpath(pkg, cfg))
     if "invariant" in cfg.passes:
         findings.extend(pass_invariant(pkg, cfg))
-    order = {"lock-order": 0, "lockset": 1, "hotpath": 2, "invariant": 3}
+    if "blocking" in cfg.passes:
+        findings.extend(pass_blocking(pkg))
+    order = {"lock-order": 0, "lockset": 1, "hotpath": 2, "invariant": 3,
+             "blocking": 4}
     findings.sort(key=lambda f: (order.get(f.pass_id, 9), f.path, f.line))
     return findings
