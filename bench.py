@@ -667,7 +667,11 @@ RESERVED_SECTIONS = {"flash_train": 360.0, "marker_overhead": 60.0,
                      # serve_* headline keys are regression-watched from
                      # round one — a gate metric without a reservation
                      # starves (the r4/r5 lesson)
-                     "serving": 60.0}
+                     "serving": 60.0,
+                     # the recovery tier (ISSUE 13): seeded
+                     # drain-and-readmit + kill-and-rejoin scenarios
+                     # minting drain_recover_ms / rejoin_converge_iters
+                     "resilience": 60.0}
 
 #: Must-run slice granted to a fairness-rotation promotion (a section
 #: budget-starved 2 rounds running) — big enough for every current
@@ -675,44 +679,38 @@ RESERVED_SECTIONS = {"flash_train": 360.0, "marker_overhead": 60.0,
 FAIRNESS_SLICE_SEC = 120.0
 
 
-_REGRESS_MOD = None
-_LOADGEN_MOD = None
+_TOOL_MODS: dict = {}
+
+
+def _load_tool(name: str):
+    """Exec tools/<name>.py (next to THIS file) as a module — tools/ is
+    not a package, the bench loads its neighbors by path.  Cached per
+    name: every call site must see ONE module object (and pay the exec
+    once per bench run)."""
+    mod = _TOOL_MODS.get(name)
+    if mod is not None:
+        return mod
+    import importlib.util
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    spec = importlib.util.spec_from_file_location(
+        f"ck_{name}", os.path.join(here, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    _TOOL_MODS[name] = mod
+    return mod
+
+
+def _load_resilience():
+    return _load_tool("resilience")
 
 
 def _load_loadgen():
-    """Exec tools/loadgen.py as a module (the _load_regress pattern:
-    tools/ is not a package, the bench loads its neighbors by path)."""
-    global _LOADGEN_MOD
-    if _LOADGEN_MOD is not None:
-        return _LOADGEN_MOD
-    import importlib.util
-
-    here = os.path.dirname(os.path.abspath(__file__))
-    spec = importlib.util.spec_from_file_location(
-        "ck_loadgen", os.path.join(here, "tools", "loadgen.py"))
-    mod = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(mod)
-    _LOADGEN_MOD = mod
-    return mod
+    return _load_tool("loadgen")
 
 
 def _load_regress():
-    """Exec tools/regress.py (it lives next to THIS file) as a module —
-    the one loader both the fairness rotation's history miner and the
-    artifact epilogue use.  Cached: both call sites must see ONE module
-    object (and pay the exec once per bench run)."""
-    global _REGRESS_MOD
-    if _REGRESS_MOD is not None:
-        return _REGRESS_MOD
-    import importlib.util
-
-    here = os.path.dirname(os.path.abspath(__file__))
-    spec = importlib.util.spec_from_file_location(
-        "ck_regress", os.path.join(here, "tools", "regress.py"))
-    mod = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(mod)
-    _REGRESS_MOD = mod
-    return mod
+    return _load_tool("regress")
 
 
 def starvation_history(repo_root: str) -> list[set]:
@@ -1060,6 +1058,16 @@ def main() -> None:
     serving = section(
         "serving", lambda: _load_loadgen().loadgen_section(devs))
 
+    # Recovery tier (ISSUE 13): one seeded drain-and-readmit scenario
+    # (an injected lane stall is quarantined by the DrainController,
+    # the share redistributed, the lane re-admitted when the injection
+    # clears — exactness-checked) plus a kill-and-rejoin checkpoint
+    # resume (cluster/elastic.py) — both minting the regression-watched
+    # drain_recover_ms / rejoin_converge_iters keys (docs/RESILIENCE.md;
+    # tools/resilience.py is the standalone CLI).
+    resilience = section(
+        "resilience", lambda: _load_resilience().resilience_section(devs))
+
     # Balancer on the 8-device rig with skewed per-range load (r2 #4).
     rig = section("balancer_rig", balancer_rig_section)
 
@@ -1142,6 +1150,7 @@ def main() -> None:
         "nbody_e2e": nbe,
         "dispatch_floor": dfloor,
         "serving": serving,
+        "resilience": resilience,
         "nbody_note": (
             "nbody_gpairs_per_sec = sync-per-call variant (host fence "
             "every iteration, RTT-bound — a dispatch-latency metric); "
@@ -1244,6 +1253,21 @@ def main() -> None:
             "serve_coalesce_ratio": (
                 serving.get("coalesce_ratio")
                 if isinstance(serving, dict) else None
+            ),
+            # the recovery tier's keys (ISSUE 13): wall from injected
+            # degradation to the drain taking effect, and post-resume
+            # windows for a kill-rejoin run's split to settle — both
+            # exactness-gated (a recovery that corrupts results
+            # reports None, which the sentinel treats as STARVED)
+            "drain_recover_ms": (
+                resilience.get("drain_recover_ms")
+                if isinstance(resilience, dict) and resilience.get("exact")
+                else None
+            ),
+            "rejoin_converge_iters": (
+                resilience.get("rejoin_converge_iters")
+                if isinstance(resilience, dict) and resilience.get("exact")
+                else None
             ),
             "dtype_cells": (
                 f"{dtypes.get('cells_pass')}p/{dtypes.get('cells_veto')}v/"

@@ -57,6 +57,31 @@ class ClusterLoadBalancer:
         self.targets = [r / total if total else 0.0 for r in ranges]
         return ranges, left
 
+    def resplit_active(self, total: int,
+                       active: Sequence[int]) -> tuple[list[int], int]:
+        """Membership-change re-split (ISSUE 13): equal LCM-chunk
+        distribution over the ACTIVE node indices only — a departed/
+        preempted node's share is 0 — with the remainder returned for
+        the mainframe (the first active node).  The balancer's targets
+        reset to the new split: the old trajectory described a
+        membership that no longer exists, and damping toward it would
+        drip work onto dead nodes.  ``cluster/elastic.member_resplit``
+        (the replay-verified decision output) is the all-active,
+        remainder-folded wrapper over this — one re-split
+        implementation, two call forms."""
+        active = sorted({int(i) for i in active})
+        if not active or any(i < 0 or i >= self.num_nodes for i in active):
+            raise ValueError(
+                f"active indices {active} invalid for {self.num_nodes} nodes")
+        sub = ClusterLoadBalancer(
+            [self.steps[i] for i in active], damping=self.damping)
+        shares, left = sub.equal_split(total)
+        out = [0] * self.num_nodes
+        for j, i in enumerate(active):
+            out[i] = shares[j]
+        self.targets = [r / total if total else 0.0 for r in out]
+        return out, left
+
     def rebalance(self, ranges: Sequence[int], times_ms: Sequence[float], total: int) -> tuple[list[int], int]:
         """Move shares toward measured performance p_i = range_i / time_i,
         damped, snapped to each node's step; remainder (sum shortfall) goes

@@ -6,17 +6,47 @@ node's share of ranges + the needed array regions, blocks on the reply,
 and writes returned slices back into the caller's host arrays (:156-259);
 ``control``/``num_devices``/``stop`` mirror the management surface
 (:260-325).
+
+Resilience contract (ISSUE 13 — the reference's TCP tier only fails
+over at connect time):
+
+- **Per-operation read timeouts.**  Every round trip runs under
+  ``op_timeout`` (``socket.settimeout`` on the connection) — a server
+  dying mid-``recv_message`` surfaces as a timeout instead of hanging
+  the client forever (the seed behavior: only the CONNECT had one).
+- **Bounded reconnect with exponential backoff + jitter.**  A failed
+  round trip (connection reset, injected socket drop, timeout)
+  reconnects and retries up to ``max_retries`` times, sleeping
+  ``backoff_s·2^k + jitter`` (capped at ``backoff_max_s``; jitter from
+  a seeded RNG so tests are deterministic).  Exhaustion raises the
+  NAMED :class:`~cekirdekler_tpu.errors.ClusterRetryExhausted` — a
+  dead node is a typed error, never a hang.
+- **Idempotent retries via a request sequence number.**  Each logical
+  operation gets one ``seq`` (``meta["seq"]``) assigned at first
+  attempt; every retry RESENDS the same seq, so a server (or a
+  dedup-aware proxy) can recognize a replay.  The retried payload is
+  identical — the client's host arrays are unchanged until a reply
+  lands, so re-execution produces the same result.
+- **Session replay.**  The server's session state (cruncher + array
+  cache) is per-connection; after a reconnect the cached ``setup``
+  is replayed before the retried operation, so a mid-job failover is
+  invisible to the caller beyond latency.
+
+Application errors (``ANSWER_ERROR``) are never retried — they are
+deterministic replies, not transport failures.
 """
 
 from __future__ import annotations
 
+import random
 import socket
 import threading
+import time
 
 import numpy as np
 
 from ..arrays.clarray import ClArray
-from ..errors import CekirdeklerError
+from ..errors import CekirdeklerError, ClusterRetryExhausted
 from .netbuffer import (
     FLAG_PARTIAL,
     FLAG_READ,
@@ -30,6 +60,10 @@ from .netbuffer import (
 )
 
 __all__ = ["CruncherClient"]
+
+_COMMAND_NAMES = {
+    v: k for k, v in vars(Command).items() if isinstance(v, int)
+}
 
 
 def _flags_of(arr: ClArray) -> int:
@@ -47,23 +81,100 @@ def _flags_of(arr: ClArray) -> int:
 
 
 class CruncherClient:
-    """Synchronous request/reply client of one compute node."""
+    """Synchronous request/reply client of one compute node (see the
+    module docstring for the retry/timeout contract)."""
 
-    def __init__(self, host: str, port: int, timeout: float = 30.0):
-        self.sock = socket.create_connection((host, port), timeout=timeout)
-        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    def __init__(self, host: str, port: int, timeout: float = 30.0,
+                 op_timeout: float = 30.0, max_retries: int = 4,
+                 backoff_s: float = 0.05, backoff_max_s: float = 2.0,
+                 retry_seed: int = 0):
+        self.host = host
+        self.port = port
+        self.timeout = float(timeout)
+        self.op_timeout = float(op_timeout)
+        self.max_retries = max(0, int(max_retries))
+        self.backoff_s = float(backoff_s)
+        self.backoff_max_s = float(backoff_max_s)
+        self._rng = random.Random(retry_seed)
         self._lock = threading.Lock()
+        self._seq = 0
+        self._setup_args: tuple[str, int] | None = None
+        self.reconnects = 0  # observability: transport failovers survived
         self.remote_devices = 0
+        self.sock = self._connect()
+
+    # -- transport ------------------------------------------------------------
+    def _connect(self) -> socket.socket:
+        sock = socket.create_connection(
+            (self.host, self.port), timeout=self.timeout)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        # per-OPERATION read timeout: a peer dying mid-recv_message
+        # surfaces as socket.timeout (an OSError) instead of a hang
+        sock.settimeout(self.op_timeout)
+        return sock
+
+    def _reconnect_locked(self) -> None:
+        """Close, reconnect, and replay the cached SETUP (the server's
+        session state is per-connection).  Caller holds the lock."""
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+        self.sock = self._connect()
+        self.reconnects += 1
+        if self._setup_args is not None:
+            source, max_devices = self._setup_args
+            send_message(self.sock, Message(
+                Command.SETUP, meta={"max_devices": max_devices},
+                strings=[source],
+            ))
+            reply = recv_message(self.sock)
+            if reply.command == Command.ANSWER_ERROR:
+                raise CekirdeklerError(
+                    "remote error replaying setup: "
+                    f"{reply.strings and reply.strings[0]}")
+            self.remote_devices = reply.meta.get("n", 0)
+
+    def _backoff(self, attempt: int) -> float:
+        base = min(self.backoff_s * (2 ** attempt), self.backoff_max_s)
+        return base * (0.5 + self._rng.random())  # jitter in [0.5, 1.5)·base
 
     def _roundtrip(self, msg: Message) -> Message:
+        """One logical operation: send + receive, with bounded
+        reconnect-and-retry on transport failure.  The operation's
+        ``seq`` is assigned ONCE — retries resend the identical
+        message (idempotency marker, see module docstring)."""
         with self._lock:
-            send_message(self.sock, msg)
-            reply = recv_message(self.sock)
+            if "seq" not in msg.meta:
+                self._seq += 1
+                msg.meta["seq"] = self._seq
+            last_exc: BaseException | None = None
+            for attempt in range(self.max_retries + 1):
+                if attempt > 0:
+                    time.sleep(self._backoff(attempt - 1))
+                    try:
+                        self._reconnect_locked()
+                    except (ConnectionError, OSError) as e:
+                        last_exc = e
+                        continue  # node still down — next backoff step
+                try:
+                    send_message(self.sock, msg)
+                    reply = recv_message(self.sock)
+                    break
+                except (ConnectionError, OSError) as e:
+                    last_exc = e
+            else:
+                op = _COMMAND_NAMES.get(msg.command, str(msg.command))
+                raise ClusterRetryExhausted(
+                    op, self.max_retries + 1, last_exc) from last_exc
         if reply.command == Command.ANSWER_ERROR:
-            raise CekirdeklerError(f"remote error: {reply.strings and reply.strings[0]}")
+            raise CekirdeklerError(
+                f"remote error: {reply.strings and reply.strings[0]}")
         return reply
 
+    # -- operations -----------------------------------------------------------
     def setup(self, kernel_source: str, max_devices: int = 0) -> int:
+        self._setup_args = (kernel_source, int(max_devices))
         reply = self._roundtrip(
             Message(
                 Command.SETUP,
@@ -130,7 +241,9 @@ class CruncherClient:
             arr.host()[rec.offset : rec.offset + rec.data.size] = rec.data
 
     def control(self) -> bool:
-        """Liveness ping (reference: control, ClCruncherClient.cs:275)."""
+        """Liveness ping (reference: control, ClCruncherClient.cs:275).
+        Retries like every op; a node dead through every attempt
+        answers False (ClusterRetryExhausted is a CekirdeklerError)."""
         try:
             return self._roundtrip(Message(Command.CONTROL)).command == Command.ANSWER_CONTROL
         except (CekirdeklerError, OSError, ConnectionError):

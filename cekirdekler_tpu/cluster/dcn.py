@@ -24,9 +24,17 @@ divergences, all TPU-pod idioms:
 - **write_all single-owner rule**: process 0 owns write_all arrays
   (broadcast_one_to_all), mirroring the TCP tier's rule that remote nodes
   never return write_all payloads (server.py).
-- **Static membership**: jax.distributed jobs cannot lose or add processes
-  mid-run, so the TCP tier's mid-compute failover has no analogue here —
-  elastic recovery stays a TCP-tier capability.
+- **Restart-shaped elasticity**: jax.distributed jobs cannot lose or add
+  processes MID-RUN, so elasticity here is preemption-shaped
+  (``cluster/elastic.py``, ISSUE 13): the job checkpoints each window's
+  partition state (atomic tmp+rename), a preempted job restarts —
+  possibly with a different process count — resumes from the last
+  complete window (:meth:`DistributedAccelerator.resume_elastic`), and
+  the membership change is recorded as replayable
+  ``member-leave``/``member-join`` decisions whose outputs are the new
+  LCM-step re-split.  A kill-and-rejoin run converges to the
+  bit-identical image of an undisturbed one
+  (tests/_dcn_elastic_worker.py).
 
 Testable without a pod: 2 processes × 4 virtual CPU devices each, with
 ``gloo`` cross-process collectives (tests/test_dcn.py).
@@ -259,10 +267,23 @@ class DistributedAccelerator(IComputeNode):
 
     def barrier(self, tag: str = "ck_dcn_barrier") -> None:
         """Cross-process sync point (reference: the TCP tier's synchronous
-        request/reply implies one; here it is explicit)."""
-        from jax.experimental import multihost_utils
+        request/reply implies one; here it is explicit).
 
-        multihost_utils.sync_global_devices(tag)
+        Rides the tier's own :meth:`_allgather` rather than
+        ``multihost_utils.sync_global_devices``: the latter reshapes the
+        device list to ``(nproc, local_count)`` and so requires every
+        process to hold the SAME device count — the exact constraint
+        ``_allgather`` exists to avoid, and elastic rejoins
+        (``resume_elastic``) are routinely asymmetric.  The gathered tag
+        hash doubles as the name-mismatch assertion."""
+        import zlib
+
+        h = np.asarray([zlib.crc32(tag.encode())], np.uint32)
+        gathered = self._allgather(h)
+        if not (gathered == h[0]).all():
+            raise CekirdeklerError(
+                f"barrier tag mismatch across processes ({tag!r}): "
+                f"{gathered.reshape(-1).tolist()}")
 
     # -- IComputeNode --------------------------------------------------------
     def setup_nodes(self, kernel_source: str) -> None:
@@ -368,6 +389,76 @@ class DistributedAccelerator(IComputeNode):
             "enqueue", _tt, cid=compute_id,
             tag=f"dcn p{self.pid}/{self.nproc} share{my_share}",
         )
+
+    # -- elastic membership & window checkpoints (cluster/elastic.py) --------
+    def member_table(self, local_range: int) -> dict:
+        """This job's elastic-membership roster: ``{"p<i>": step}`` with
+        step = process i's device count × ``local_range`` (the LCM-step
+        table's row).  Requires :meth:`setup_nodes` (the agreed
+        device-count table is the input)."""
+        if not self.proc_device_counts:
+            raise CekirdeklerError(
+                "setup_nodes() must run before member_table()")
+        return {
+            f"p{i}": c * local_range
+            for i, c in enumerate(self.proc_device_counts)
+        }
+
+    def establish_membership(self, local_range: int,
+                             prev_steps: Sequence[int] | None = None,
+                             total: int | None = None):
+        """Epoch-numbered membership for this job (elastic.Membership).
+
+        ``prev_steps`` is a previous incarnation's member-step table
+        (from a window checkpoint): when it differs from the current
+        roster, the leave/join transitions — a preempted member gone,
+        a rejoined one back, a resized one re-split — are recorded as
+        replayable decisions carrying the new LCM-step re-split over
+        ``total``.  Every process runs the same reconciliation on the
+        same inputs (SPMD), so the recorded sequences agree."""
+        from .elastic import Membership
+
+        m = Membership()
+        if prev_steps:
+            m.establish({
+                f"p{i}": int(s) for i, s in enumerate(prev_steps)})
+            m.sync(self.member_table(local_range), total)
+        else:
+            m.establish(self.member_table(local_range))
+        return m
+
+    def checkpoint_window(self, root: str, window: int, arrays: dict,
+                          local_range: int) -> str | None:
+        """Persist one completed window's partition state (process 0
+        only — post-exchange every process holds identical host
+        arrays, and N writers racing one step dir would be N-1 wasted
+        renames).  Callers barrier AFTER this so no process runs ahead
+        of a checkpoint that may need to be resumed."""
+        if self.pid != 0:
+            return None
+        from .elastic import save_window
+
+        steps = [c * local_range for c in self.proc_device_counts]
+        return save_window(root, window, arrays, member_steps=steps)
+
+    def resume_elastic(self, root: str, local_range: int,
+                       total: int | None = None) -> dict | None:
+        """Resume a preempted job: load the newest COMPLETE window
+        checkpoint (torn newest falls back — utils/checkpoint.py),
+        reconcile membership against the checkpointed roster (recorded
+        leave/join re-splits), and return ``{"window", "arrays",
+        "member_steps", "membership"}`` — or None on a fresh start."""
+        from .elastic import resume_window
+
+        state = resume_window(root)
+        membership = self.establish_membership(
+            local_range,
+            prev_steps=(state or {}).get("member_steps"),
+            total=total)
+        if state is None:
+            return None
+        state["membership"] = membership
+        return state
 
     # -- introspection (obs/) ------------------------------------------------
     def health_report(self) -> dict:

@@ -24,6 +24,8 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
+from ..utils.faultinject import FAULTS
+
 __all__ = [
     "Command",
     "ArrayRecord",
@@ -159,7 +161,20 @@ _HEADER = struct.Struct("!BQ")
 
 def send_message(sock, msg: Message) -> None:
     payload = msg.encode()
-    sock.sendall(_HEADER.pack(msg.command, len(payload)) + payload)
+    data = _HEADER.pack(msg.command, len(payload)) + payload
+    if FAULTS.enabled and FAULTS.fire("socket-drop", where="send"):
+        # chaos plane: disconnect MID-message — half the frame lands,
+        # then the socket dies (the peer's recv sees a torn message;
+        # this side's next op sees a dead socket)
+        try:
+            sock.sendall(data[: max(1, len(data) // 2)])
+        finally:
+            try:
+                sock.close()
+            except OSError:
+                pass
+        raise ConnectionError("injected socket drop mid-send (CK_FAULTS)")
+    sock.sendall(data)
 
 
 def _recv_exact(sock, n: int) -> bytes:
@@ -175,6 +190,12 @@ def _recv_exact(sock, n: int) -> bytes:
 
 
 def recv_message(sock) -> Message:
+    if FAULTS.enabled and FAULTS.fire("socket-drop", where="recv"):
+        try:
+            sock.close()
+        except OSError:
+            pass
+        raise ConnectionError("injected socket drop mid-recv (CK_FAULTS)")
     header = _recv_exact(sock, _HEADER.size)
     command, length = _HEADER.unpack(header)
     payload = _recv_exact(sock, length) if length else b""

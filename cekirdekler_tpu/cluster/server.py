@@ -194,6 +194,18 @@ class CruncherServer:
                 conn, addr = self._sock.accept()
             except OSError:
                 break
+            if not self._running:
+                # stop() raced the blocked accept: on Linux, close()
+                # alone does NOT wake a thread blocked in accept() (the
+                # syscall pins the kernel socket, which keeps LISTENING)
+                # — one post-stop connection could land here and be
+                # served by a "stopped" server.  Found by the reconnect
+                # client retrying a stopped node (ISSUE 13).
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                break
             self._sessions = [s for s in self._sessions if s.is_alive()]
             if len(self._sessions) >= self.max_sessions:
                 # reject-with-a-name, never a silent hang: the client's
@@ -236,6 +248,15 @@ class CruncherServer:
 
     def stop(self) -> None:
         self._running = False
+        try:
+            # shutdown BEFORE close: close() does not wake a thread
+            # blocked in accept() on Linux (the syscall holds a kernel
+            # reference, so the socket keeps listening and accepts one
+            # more connection); shutdown() forces the blocked accept to
+            # return, so a stopped server genuinely stops accepting
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass  # platform may refuse shutdown on a listening socket
         try:
             self._sock.close()
         except OSError:

@@ -42,8 +42,10 @@ from ..kernel.registry import KernelProgram
 from ..metrics.registry import REGISTRY
 from ..obs.debugserver import DEBUG_PORT_ENV
 from ..obs.decisions import DECISIONS
+from ..obs.drain import DrainController, apply_quarantine
 from ..obs.flight import FLIGHT, record_crash
 from ..obs.health import HealthMonitor
+from ..utils.faultinject import FAULTS
 from ..trace.attribution import split_fence_benches
 from ..trace.spans import TRACER
 from .balance import (
@@ -324,6 +326,13 @@ class Cores:
         # health_report() / /healthz read the verdicts, suggest_drain()
         # is advisory only (eviction is ROADMAP item 4's business)
         self.health = HealthMonitor()
+        # drain ACTUATOR (obs/drain.py): consumes the monitor's
+        # verdicts at every barrier — a degraded lane is quarantined
+        # (share masked to 0 via apply_quarantine in _ranges_for, the
+        # displaced share redistributed onto surviving lanes), probed
+        # after a hold, and re-admitted with hysteresis when the
+        # verdict clears.  Advisory became action (ROADMAP item 4).
+        self.drain = DrainController(self.health, lanes=len(self.workers))
         # live introspection plane (obs/debugserver.py): started by
         # serve_debug() or, for the FIRST Cores in the process, by
         # CK_DEBUG_PORT (a busy port is skipped silently — one debug
@@ -443,6 +452,16 @@ class Cores:
                     carry = self._cont_ranges.setdefault(compute_id, [])
                     ranges = load_balance(bench, ranges, total, step, hist,
                                           carry=carry, cid=compute_id)
+        # drain mask (obs/drain.py): quarantined lanes hold 0, probation
+        # lanes hold exactly one probe step, displaced share moves to
+        # the actives — applied to CACHED tables too (idempotent), so a
+        # barrier-time drain takes effect on the very next call even
+        # without an armed rebalance
+        if self.drain.enabled:
+            drained = self.drain.drained_lanes()
+            probing = self.drain.probe_lanes()
+            if drained or probing:
+                ranges = apply_quarantine(ranges, step, drained, probing)
         self.global_ranges[compute_id] = ranges
         refs = [0] * n
         acc = 0
@@ -2187,6 +2206,14 @@ class Cores:
             comp_at: dict[int, list[tuple[int, float]]] = {}
 
             def fence_timed(w: Worker) -> None:
+                if FAULTS.enabled:
+                    # injected lane stall (utils/faultinject.py): the
+                    # lane's fence-retire wall inflates exactly like a
+                    # real degradation — the chaos plane's barrier point
+                    _d = FAULTS.delay_s(
+                        "lane-stall", lane=w.index, where="barrier")
+                    if _d > 0.0:
+                        time.sleep(_d)
                 comps: list[tuple[int, float]] = []
                 for cid in split_order:
                     rng = self.global_ranges.get(cid)
@@ -2219,7 +2246,18 @@ class Cores:
                 # un-normalized feed would flip EVERY lane degraded on a
                 # pure cadence change
                 window_iters = max(1, sum(window_iters_map.values()))
+                quarantined = self.drain.drained_lanes() \
+                    if self.drain.enabled else set()
                 for w in self.workers:
+                    if w.index in quarantined:
+                        # a share-0 lane ran nothing: its near-zero
+                        # fence wall is not evidence, and letting it
+                        # into the rolling baseline would make every
+                        # later probe wall ratio as "degraded" against
+                        # a corrupted near-zero baseline — the
+                        # probation↔quarantine oscillation the chaos
+                        # suite reproduced
+                        continue
                     self.health.observe(
                         w.index, "fence",
                         (done_at[w.index] - t0) / window_iters)
@@ -2264,9 +2302,28 @@ class Cores:
             # CK_DECISION_LOG; a no-op attribute check otherwise) — the
             # barrier is the coldest periodic point the runtime has
             DECISIONS.maybe_spill()
+            # drain actuation: the barrier is the ONE place quarantine
+            # state moves (drains happen at window boundaries, never
+            # mid-window); a state change arms a rebalance so the next
+            # call re-splits — and in enqueue mode takes the existing
+            # flush+coverage-reset path for the moved ranges
+            self._drain_evaluate()
             # always close the window — a fence failure must not leave a
             # stale t0/cid set to corrupt the NEXT window's benches
             self._enqueue_window_closed()
+
+    def _drain_evaluate(self) -> None:
+        """Run one DrainController transition (barrier tail).  Guarded:
+        it runs inside the barrier's ``finally``, where an exception
+        would mask the fence error the barrier exists to surface."""
+        try:
+            res = self.drain.evaluate()
+        except Exception as e:  # noqa: BLE001 - must not mask fence errors
+            FLIGHT.event("drain-apply", error=f"{type(e).__name__}: {e}"[:200])
+            return
+        if res and (res["drained"] or res["readmitted"] or res["probed"]):
+            with self._lock:
+                self._enqueue_rebalance |= set(self.global_ranges.keys())
 
     def _enqueue_window_closed(self) -> None:
         # under the lock: compute() holds it across its check+remove on

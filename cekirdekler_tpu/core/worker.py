@@ -38,6 +38,7 @@ from ..metrics.registry import REGISTRY
 from ..obs.flight import FLIGHT
 from ..trace.device import MARKS
 from ..trace.spans import TRACER
+from ..utils.faultinject import FAULTS
 from ..utils.markers import MarkerCounter
 
 __all__ = ["Worker"]
@@ -109,8 +110,10 @@ class _DriverQueue:
     sync point, never masquerade as fast device work — the barrier()
     error contract)."""
 
-    def __init__(self, depth_gauge=None, name: str = "driver"):
+    def __init__(self, depth_gauge=None, name: str = "driver",
+                 lane: int | None = None):
         self._q: queue.Queue = queue.Queue()
+        self.lane = lane  # fault-point selector (utils/faultinject.py)
         self._cond = threading.Condition()
         self._errors: list[Exception] = []
         self._pending = 0
@@ -122,6 +125,13 @@ class _DriverQueue:
         self._thread.start()
 
     def submit(self, fn: Callable[[], None], depth: int = 2) -> None:
+        if FAULTS.enabled:
+            # chaos plane (utils/faultinject.py): an armed driver-submit
+            # clause makes THIS submit raise InjectedFaultError — the
+            # fused window poisons and the error surfaces at the sync
+            # point, exactly like a real dispatch failure
+            FAULTS.raise_if_fired("driver-submit", lane=self.lane,
+                                  where=self.name)
         with self._cond:
             if self._errors:
                 e = self._errors[0]
@@ -346,7 +356,24 @@ class Worker:
         return buf
 
     def _h2d(self, host_slice: np.ndarray, zero_copy: bool):
-        """One H2D transfer.  ``zero_copy`` requests the
+        """One H2D transfer (every upload path funnels here — including
+        staged/streamed chunks).  With an armed ``slow-link`` fault
+        clause (utils/faultinject.py) the transfer runs Nx slower: the
+        injected sleep scales the measured staging wall, so the lane's
+        transfer benchmarks, health baseline, and balancer floor all
+        see a REAL Nx-degraded link."""
+        if FAULTS.enabled:
+            t0 = time.perf_counter()
+            out = self._h2d_impl(host_slice, zero_copy)
+            d = FAULTS.delay_s("slow-link", lane=self.index, where="h2d",
+                               base_s=time.perf_counter() - t0)
+            if d > 0.0:
+                time.sleep(d)
+            return out
+        return self._h2d_impl(host_slice, zero_copy)
+
+    def _h2d_impl(self, host_slice: np.ndarray, zero_copy: bool):
+        """``zero_copy`` requests the
         ``CL_MEM_USE_HOST_PTR`` analogue (SURVEY.md §7): import the host
         buffer via dlpack — genuinely zero-copy on the CPU backend when the
         FastArr-aligned memory can be aliased — falling back to a direct
@@ -497,7 +524,8 @@ class Worker:
         submit, not only to the queue's creation."""
         if self._driver is None:
             self._driver = _DriverQueue(
-                self._m_driver_depth, name=f"fused:lane{self.index}")
+                self._m_driver_depth, name=f"fused:lane{self.index}",
+                lane=self.index)
         self._driver.submit(fn, depth)
 
     def drain_dispatch(self) -> None:
@@ -518,7 +546,8 @@ class Worker:
         stage ahead of the dispatched chunk — the double buffer."""
         if self._stream_driver is None:
             self._stream_driver = _DriverQueue(
-                self._m_stream_depth, name=f"stream:lane{self.index}")
+                self._m_stream_depth, name=f"stream:lane{self.index}",
+                lane=self.index)
         self._stream_driver.submit(fn, depth)
 
     def drain_stream_dispatch(self) -> None:
@@ -739,6 +768,11 @@ class Worker:
     def finish_download(handle) -> None:
         arr, out, off, markers, lane, byte_counter, kind = handle
         _tt = TRACER.t0()
+        # capture the fault-plane state ONCE: a plane armed mid-call
+        # would otherwise pair delay_s with the 0.0 sentinel t0 and
+        # scale the injected sleep by absolute process uptime
+        _faults = FAULTS.enabled
+        _ft0 = time.perf_counter() if _faults else 0.0
         host = arr.host()
         data = np.asarray(out)
         view = host[off : off + data.size]
@@ -762,6 +796,14 @@ class Worker:
         else:
             view[:] = data
         byte_counter.inc(data.nbytes)
+        if _faults:
+            # chaos plane: the D2H half of an injected Nx slow link —
+            # the flush drain's per-lane attribution (the balancer
+            # floor's feed) sees the degradation like a real one
+            d = FAULTS.delay_s("slow-link", lane=lane, where="d2h",
+                               base_s=time.perf_counter() - _ft0)
+            if d > 0.0:
+                time.sleep(d)
         TRACER.record(kind, _tt, lane=lane, tag=arr.name)
         if markers is not None:
             markers.reach()

@@ -61,3 +61,33 @@ class ClusterError(CekirdeklerError):
 
 class PoolError(CekirdeklerError):
     """Task/device pool misuse or scheduling failure."""
+
+
+class ClusterRetryExhausted(ClusterError):
+    """A cluster client operation failed through every reconnect
+    attempt (``cluster/client.py``'s bounded exponential-backoff
+    retry loop).  Carries the attempt count and the final cause —
+    the named, non-hanging end state of a dead or unreachable node."""
+
+    def __init__(self, op: str, attempts: int, cause: BaseException):
+        self.op = op
+        self.attempts = attempts
+        self.cause = cause
+        super().__init__(
+            f"cluster op {op!r} failed after {attempts} attempt(s); "
+            f"last error: {type(cause).__name__}: {cause}"
+        )
+
+
+class InjectedFaultError(CekirdeklerError):
+    """A DELIBERATELY injected fault fired (``utils/faultinject.py``,
+    armed by ``CK_FAULTS``) — named so chaos tests and postmortems can
+    tell an injected failure from a real one."""
+
+    def __init__(self, point: str, lane=None, where=None):
+        self.point = point
+        self.lane = lane
+        self.where = where
+        at = f" lane={lane}" if lane is not None else ""
+        at += f" where={where}" if where is not None else ""
+        super().__init__(f"injected fault at point {point!r}{at} (CK_FAULTS)")

@@ -105,6 +105,11 @@ DECISION_KINDS = (
     "scheduler-rotation",  # bench.SectionScheduler — fairness promotion
     "admission",           # serve/admission — one request admitted/rejected
     "coalesce",            # serve/coalescer — one dispatch cycle's batch plan
+    "drain-apply",         # obs/drain — lanes quarantined (advice became action)
+    "readmit",             # obs/drain — quarantined lanes re-admitted
+    "member-leave",        # cluster/elastic — a member departed, re-split
+    "member-join",         # cluster/elastic — a member arrived, re-split
+    "checkpoint-restore",  # cluster/elastic — a run resumed from a window ckpt
 )
 
 #: The subset replay-verify re-executes: decisions that are pure
@@ -114,6 +119,7 @@ DECISION_KINDS = (
 REPLAYABLE_KINDS = (
     "load-balance", "transfer-choose", "transfer-observe", "health-verdict",
     "admission", "coalesce",
+    "drain-apply", "readmit", "member-leave", "member-join",
 )
 
 #: Spill-buffer bound: the armed jsonl accumulation is capped so a

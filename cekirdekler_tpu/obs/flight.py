@@ -91,6 +91,10 @@ EVENT_KINDS = (
     "kernel-verify",
     "debug-server", "debug-port-skipped",
     "profiler-start", "profiler-stop",
+    "fault-injected",
+    "drain-apply", "readmit", "drain-probe",
+    "member-leave", "member-join",
+    "checkpoint-restore", "checkpoint-fallback", "checkpoint-sweep",
 )
 
 #: Postmortem JSON schema tag.  v2 (this revision) embeds the decision

@@ -333,6 +333,54 @@ def _replay_coalesce(inp: dict, out: dict) -> dict:
     return mism
 
 
+def _replay_drain(inp: dict, out: dict) -> dict:
+    from .drain import drain_transition
+
+    got = drain_transition(
+        inp.get("verdicts") or {}, inp.get("states") or {},
+        inp.get("hold") or {}, inp.get("clear_streak") or {},
+        int(inp.get("hold_barriers", 2)), int(inp.get("confirm_clear", 2)),
+        probe_grace=int(inp.get("probe_grace", 2)),
+    )
+    mism: dict = {}
+    for k in ("drained", "readmitted", "probed", "states", "hold",
+              "clear_streak"):
+        ev = out.get(k)
+        ev = list(ev) if isinstance(ev, list) else ev
+        gv = got[k]
+        if gv != ev:
+            mism[k] = {"expected": ev, "got": gv}
+    return mism
+
+
+def _replay_member(inp: dict, out: dict) -> dict:
+    """member-leave / member-join: the recorded re-split over the
+    post-change step table must re-execute bit-identically (when the
+    record carried a total — membership transitions with no known
+    workload record only the roster, nothing to re-derive)."""
+    from ..cluster.elastic import member_resplit
+
+    mism: dict = {}
+    steps = inp.get("steps_after") or []
+    total = inp.get("total")
+    if total is not None and steps:
+        got = member_resplit(steps, int(total))
+        for k in ("ranges", "lcm"):
+            ev = out.get(k)
+            ev = list(ev) if isinstance(ev, list) else ev
+            gv = got[k]
+            gv = list(gv) if isinstance(gv, list) else gv
+            if gv != ev:
+                mism[k] = {"expected": ev, "got": gv}
+    rec_epoch = out.get("epoch_after")
+    got_epoch = int(inp.get("epoch_before", 0)) + 1
+    if rec_epoch is not None and rec_epoch != got_epoch:
+        # same label convention as ranges/lcm above: "expected" is the
+        # RECORDED output, "got" the re-derived value
+        mism["epoch_after"] = {"expected": rec_epoch, "got": got_epoch}
+    return mism
+
+
 _REPLAYERS = {
     "load-balance": _replay_load_balance,
     "transfer-choose": _replay_transfer_choose,
@@ -340,6 +388,10 @@ _REPLAYERS = {
     "health-verdict": _replay_health_verdict,
     "admission": _replay_admission,
     "coalesce": _replay_coalesce,
+    "drain-apply": _replay_drain,
+    "readmit": _replay_drain,
+    "member-leave": _replay_member,
+    "member-join": _replay_member,
 }
 assert set(_REPLAYERS) == set(REPLAYABLE_KINDS)
 

@@ -151,8 +151,14 @@ class ServeFrontend:
         self.name = str(name)
         self.cruncher = cruncher
         self.cores = cruncher.cores
+        # drain-aware health gate (obs/drain.py): a degraded lane that
+        # the DrainController already quarantined means REDUCED CAPACITY,
+        # not an outage — its share is redistributed and requests
+        # re-dispatch onto the surviving lanes, so admission keeps
+        # admitting (the raw HealthMonitor.healthy() would reject the
+        # whole tier for the duration of every drain)
         self.admission = admission or AdmissionController(
-            health=self.cores.health.healthy)
+            health=self.cores.drain.healthy_with_drains)
         self.tenants = TenantTable()
         self.max_batch = max(1, int(max_batch))
         self.max_groups_per_cycle = max(0, int(max_groups_per_cycle))

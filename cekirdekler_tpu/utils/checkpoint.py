@@ -14,6 +14,16 @@ new subsystem the TPU build adds.  Two surfaces:
 
 Writes are atomic: a temp directory renamed into place, so a killed run
 never leaves a half checkpoint (resume-safety the reference lacks).
+
+Corruption tolerance (ISSUE 13): the NEWEST step can still be torn by
+an unlucky crash (a partially-written .npz inside an already-renamed
+dir cannot happen, but disk faults and manual copies do) — so
+:func:`load_arrays`/:func:`load_pytree` with ``step=None`` fall back
+to the previous COMPLETE step when the newest fails to parse,
+recording a ``checkpoint-fallback`` flight event; an EXPLICIT step
+still raises (the caller pinned exactness).  Stale ``.ckpt_tmp_*``
+dirs abandoned by a crashed writer are swept on the next save
+(age-gated so a concurrent writer's live tmp survives).
 """
 
 from __future__ import annotations
@@ -22,6 +32,7 @@ import json
 import os
 import shutil
 import tempfile
+import time
 from typing import Any, Callable, Mapping
 
 import jax
@@ -35,24 +46,69 @@ __all__ = [
     "latest_step",
 ]
 
+#: A ``.ckpt_tmp_*`` dir older than this at save time belongs to a
+#: crashed writer and is swept (a live concurrent writer's tmp is
+#: seconds old; single-writer-per-root is the supported pattern).
+TMP_SWEEP_AGE_S = 60.0
+
 
 def _step_dir(root: str, step: int) -> str:
     return os.path.join(root, f"step_{step:012d}")
 
 
-def latest_step(root: str) -> int | None:
-    """Highest checkpoint step under ``root`` (None if empty)."""
+def _steps_desc(root: str) -> list[int]:
     if not os.path.isdir(root):
-        return None
-    steps = [
+        return []
+    return sorted((
         int(name[5:]) for name in os.listdir(root)
         if name.startswith("step_") and name[5:].isdigit()
-    ]
-    return max(steps) if steps else None
+    ), reverse=True)
+
+
+def latest_step(root: str) -> int | None:
+    """Highest checkpoint step under ``root`` (None if empty)."""
+    steps = _steps_desc(root)
+    return steps[0] if steps else None
+
+
+def _note_fallback(root: str, bad_step: int, exc: BaseException,
+                   to_step: int | None) -> None:
+    from ..obs.flight import FLIGHT
+
+    FLIGHT.event(
+        "checkpoint-fallback", root=root, bad_step=bad_step,
+        fell_back_to=to_step,
+        error=f"{type(exc).__name__}: {exc}"[:200])
+
+
+def _sweep_stale_tmps(root: str) -> int:
+    """Remove crashed writers' abandoned tmp dirs (age-gated).
+    Returns how many were swept; never raises."""
+    swept = 0
+    try:
+        now = time.time()
+        for name in os.listdir(root):
+            if not name.startswith(".ckpt_tmp_"):
+                continue
+            path = os.path.join(root, name)
+            try:
+                if now - os.path.getmtime(path) > TMP_SWEEP_AGE_S:
+                    shutil.rmtree(path, ignore_errors=True)
+                    swept += 1
+            except OSError:
+                continue
+        if swept:
+            from ..obs.flight import FLIGHT
+
+            FLIGHT.event("checkpoint-sweep", root=root, swept=swept)
+    except Exception:  # noqa: BLE001 - sweeping is best-effort hygiene
+        pass
+    return swept
 
 
 def _atomic_write(root: str, step: int, write_fn: Callable[[str], None]) -> str:
     os.makedirs(root, exist_ok=True)
+    _sweep_stale_tmps(root)
     final = _step_dir(root, step)
     tmp = tempfile.mkdtemp(prefix=".ckpt_tmp_", dir=root)
     try:
@@ -80,14 +136,30 @@ def save_arrays(root: str, step: int, arrays: Mapping[str, Any]) -> str:
     return _atomic_write(root, step, write)
 
 
-def load_arrays(root: str, step: int | None = None) -> dict[str, np.ndarray]:
-    """Load the arrays of ``step`` (default: latest)."""
-    if step is None:
-        step = latest_step(root)
-        if step is None:
-            raise FileNotFoundError(f"no checkpoints under {root}")
+def _load_arrays_step(root: str, step: int) -> dict[str, np.ndarray]:
     with np.load(os.path.join(_step_dir(root, step), "arrays.npz")) as z:
         return {k: z[k].copy() for k in z.files}
+
+
+def load_arrays(root: str, step: int | None = None) -> dict[str, np.ndarray]:
+    """Load the arrays of ``step`` (default: latest COMPLETE step — a
+    torn/corrupt newest falls back to the previous one with a
+    ``checkpoint-fallback`` flight event; an explicit ``step`` raises
+    on corruption, the caller pinned exactness)."""
+    if step is not None:
+        return _load_arrays_step(root, step)
+    steps = _steps_desc(root)
+    if not steps:
+        raise FileNotFoundError(f"no checkpoints under {root}")
+    last_exc: BaseException | None = None
+    for i, s in enumerate(steps):
+        try:
+            return _load_arrays_step(root, s)
+        except Exception as e:  # noqa: BLE001 - torn newest, try previous
+            _note_fallback(root, s, e,
+                           steps[i + 1] if i + 1 < len(steps) else None)
+            last_exc = e
+    raise last_exc
 
 
 # -- pytree surface ----------------------------------------------------------
@@ -122,22 +194,44 @@ def load_pytree(
     pytree).  ``sharding_fn(like_leaf, loaded)`` may re-place each leaf
     (e.g. ``lambda l, x: jax.device_put(x, l.sharding)``).
     """
-    if step is None:
-        step = latest_step(root)
-        if step is None:
-            raise FileNotFoundError(f"no checkpoints under {root}")
-    d = _step_dir(root, step)
     like_leaves, treedef = jax.tree_util.tree_flatten(like)
-    with open(os.path.join(d, "manifest.json")) as f:
-        manifest = json.load(f)
-    if manifest["n_leaves"] != len(like_leaves):
-        raise ValueError(
-            f"checkpoint has {manifest['n_leaves']} leaves, 'like' tree has {len(like_leaves)}"
-        )
-    loaded = []
-    for i, like_leaf in enumerate(like_leaves):
-        x = np.load(os.path.join(d, f"leaf_{i:05d}.npy"))
-        if sharding_fn is not None:
-            x = sharding_fn(like_leaf, x)
-        loaded.append(x)
-    return jax.tree_util.tree_unflatten(treedef, loaded)
+
+    class _LeafMismatch(ValueError):
+        """A COMPLETE dir disagreeing with `like` — a caller error the
+        fallback must NOT absorb (json.JSONDecodeError is also a
+        ValueError, so the sentinel keeps torn manifests fallable)."""
+
+    def load_step(d: str):
+        with open(os.path.join(d, "manifest.json")) as f:
+            manifest = json.load(f)
+        if manifest["n_leaves"] != len(like_leaves):
+            raise _LeafMismatch(
+                f"checkpoint has {manifest['n_leaves']} leaves, 'like' tree has {len(like_leaves)}"
+            )
+        loaded = []
+        for i, like_leaf in enumerate(like_leaves):
+            x = np.load(os.path.join(d, f"leaf_{i:05d}.npy"))
+            if sharding_fn is not None:
+                x = sharding_fn(like_leaf, x)
+            loaded.append(x)
+        return jax.tree_util.tree_unflatten(treedef, loaded)
+
+    if step is not None:
+        return load_step(_step_dir(root, step))
+    steps = _steps_desc(root)
+    if not steps:
+        raise FileNotFoundError(f"no checkpoints under {root}")
+    last_exc: BaseException | None = None
+    for i, s in enumerate(steps):
+        try:
+            return load_step(_step_dir(root, s))
+        except _LeafMismatch:
+            # a complete dir whose leaf count disagrees with `like` is
+            # a CALLER error (wrong tree), not a torn checkpoint — an
+            # older step would silently load the wrong model
+            raise
+        except Exception as e:  # noqa: BLE001 - torn newest, try previous
+            _note_fallback(root, s, e,
+                           steps[i + 1] if i + 1 < len(steps) else None)
+            last_exc = e
+    raise last_exc

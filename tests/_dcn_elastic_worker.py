@@ -1,0 +1,138 @@
+"""Worker process for the kill-and-rejoin DCN job (tests/test_dcn.py →
+``test_kill_and_rejoin_converges_bit_identical``).  Run as
+``python tests/_dcn_elastic_worker.py <pid> <nproc> <port> <counts>
+<ckpt_root> <phase> <windows> <kill_after>``.
+
+The job accumulates ``y += 2·x`` per window through the DCN tier,
+checkpointing every completed window via ``cluster/elastic.py``
+(process 0 writes, everyone barriers — atomic tmp+rename, so a kill
+can never leave a half-window).
+
+Phases:
+
+- ``first`` — runs windows 1..kill_after, then every process dies via
+  ``os._exit(EXIT_PREEMPTED)`` — a preemption: no cleanup, no flush,
+  no dispose.  The parent then plants a TORN newest step dir so the
+  resume also exercises the corrupt-checkpoint fallback.
+- ``rejoin`` — a NEW job (different port, possibly different
+  per-process device counts = a membership change): resumes from the
+  last COMPLETE window (``DistributedAccelerator.resume_elastic`` —
+  falls back past the torn step), reconciles membership (recorded
+  ``member-leave``/``member-join`` decisions with the new LCM-step
+  re-split), runs the remaining windows, and asserts the final image
+  is BIT-IDENTICAL to the undisturbed run's (the host-side reference
+  applies the same per-element f32 op sequence — window count exact,
+  no lost or duplicated window updates).  The spilled decision log
+  (CK_DECISION_LOG) must replay green through ``verify_records``,
+  membership transitions included.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+SRC = """
+__kernel void accum(__global float* x, __global float* y, float a) {
+    int i = get_global_id(0);
+    y[i] = y[i] + a * x[i];
+}
+"""
+
+LOCAL_RANGE = 64
+N = 4096
+A = 2.0
+EXIT_PREEMPTED = 17
+
+
+def reference_image(windows: int) -> np.ndarray:
+    """The undisturbed run's image, computed with the identical
+    per-element f32 op sequence (y starts at 1, gains 2x per window) —
+    bit-identical to any correct run regardless of partitioning."""
+    x = np.arange(N, dtype=np.float32)
+    y = np.ones(N, np.float32)
+    for _ in range(windows):
+        y = (y + np.float32(A) * x).astype(np.float32)
+    return y
+
+
+def main(pid: int, nproc: int, port: int, counts: list[int],
+         ckpt_root: str, phase: str, windows: int, kill_after: int) -> None:
+    from cekirdekler_tpu.arrays.clarray import ClArray
+    from cekirdekler_tpu.cluster.dcn import DistributedAccelerator, initialize
+
+    initialize(f"localhost:{port}", nproc, pid)
+    import jax
+
+    assert jax.local_device_count() == counts[pid]
+    acc = DistributedAccelerator()
+    acc.setup_nodes(SRC)
+    assert acc.proc_device_counts == counts, acc.proc_device_counts
+
+    x = ClArray(np.arange(N, dtype=np.float32), partial_read=True,
+                read_only=True)
+    y = ClArray(np.ones(N, np.float32), partial_read=True)
+    start_window = 0
+
+    if phase == "rejoin":
+        state = acc.resume_elastic(ckpt_root, LOCAL_RANGE, total=N)
+        assert state is not None, "rejoin found no checkpoint"
+        # the parent planted a torn step at kill_after+1: the resume
+        # must have fallen back to the last COMPLETE window
+        assert state["window"] == kill_after, state["window"]
+        start_window = state["window"]
+        y.host()[:] = state["arrays"]["y"]
+        m = state["membership"]
+        assert m.epoch >= 1
+        if state["member_steps"] != [c * LOCAL_RANGE for c in counts]:
+            # the roster changed across the restart: transitions were
+            # recorded (epoch moved past the establish)
+            assert m.epoch > 1, m.snapshot()
+    else:
+        # fresh start still records its membership epoch
+        acc.establish_membership(LOCAL_RANGE)
+
+    for w in range(start_window + 1, windows + 1):
+        acc.compute(["accum"], [x, y], compute_id=1, global_range=N,
+                    local_range=LOCAL_RANGE, values=(A,))
+        acc.checkpoint_window(ckpt_root, w, {"y": np.asarray(y)},
+                              LOCAL_RANGE)
+        acc.barrier(f"ckpt_{w}")
+        if phase == "first" and w >= kill_after:
+            # the preemption: die with no cleanup whatsoever
+            sys.stdout.flush()
+            os._exit(EXIT_PREEMPTED)
+
+    np.testing.assert_array_equal(np.asarray(y), reference_image(windows))
+
+    # the recorded decisions — membership transitions, checkpoint
+    # restore, the balancer's re-splits — must replay bit-identically
+    from cekirdekler_tpu.obs.decisions import DECISIONS, load_decision_log
+    from cekirdekler_tpu.obs.replay import verify_records
+
+    spill = DECISIONS.maybe_spill(force=True)
+    if spill:
+        rows = load_decision_log(spill)
+        verdict = verify_records(rows)
+        assert verdict["ok"], verdict["first_divergence"]
+        kinds = {r.kind for r in rows}
+        if phase == "rejoin":
+            assert "checkpoint-restore" in kinds, kinds
+            if counts != [2, 2]:  # the membership-change variant
+                assert "member-leave" in kinds or "member-join" in kinds, \
+                    kinds
+        print(f"DCN_ELASTIC_REPLAY pid={pid} ok={verdict['ok']} "
+              f"replayed={verdict['replayed']}", flush=True)
+    print(f"DCN_ELASTIC_OK pid={pid} phase={phase} windows={windows}",
+          flush=True)
+    acc.dispose()
+
+
+if __name__ == "__main__":
+    main(
+        int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3]),
+        [int(c) for c in sys.argv[4].split(",")],
+        sys.argv[5], sys.argv[6], int(sys.argv[7]), int(sys.argv[8]),
+    )

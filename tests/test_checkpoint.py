@@ -1,0 +1,163 @@
+"""utils/checkpoint.py — its first direct unit tests (ISSUE 13
+satellite): atomic save/load round trips for both surfaces, the
+torn/corrupt-newest fallback (with the named flight event), the
+explicit-step exactness contract, and stale-tmp sweeping."""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from cekirdekler_tpu.obs.flight import FLIGHT
+from cekirdekler_tpu.utils import checkpoint as ckpt
+
+
+def _corrupt_step(root: str, step: int, surface: str = "arrays") -> str:
+    d = os.path.join(root, f"step_{step:012d}")
+    os.makedirs(d, exist_ok=True)
+    name = "arrays.npz" if surface == "arrays" else "manifest.json"
+    with open(os.path.join(d, name), "wb") as f:
+        f.write(b"this is not a valid file")
+    return d
+
+
+# ---------------------------------------------------------------------------
+# round trips
+# ---------------------------------------------------------------------------
+
+def test_arrays_round_trip_and_latest_step(tmp_path):
+    root = str(tmp_path)
+    a = np.arange(16, dtype=np.float32)
+    b = np.ones(4, np.int64)
+    ckpt.save_arrays(root, 3, {"a": a, "b": b})
+    ckpt.save_arrays(root, 7, {"a": a * 2, "b": b * 2})
+    assert ckpt.latest_step(root) == 7
+    out = ckpt.load_arrays(root)
+    np.testing.assert_array_equal(out["a"], a * 2)
+    np.testing.assert_array_equal(out["b"], b * 2)
+    old = ckpt.load_arrays(root, step=3)
+    np.testing.assert_array_equal(old["a"], a)
+
+
+def test_pytree_round_trip(tmp_path):
+    root = str(tmp_path)
+    tree = {"w": np.arange(6, dtype=np.float32).reshape(2, 3),
+            "b": [np.zeros(3, np.float32), np.float32(2.5)]}
+    ckpt.save_pytree(root, 1, tree)
+    out = ckpt.load_pytree(root, tree)
+    np.testing.assert_array_equal(out["w"], tree["w"])
+    np.testing.assert_array_equal(out["b"][0], tree["b"][0])
+    assert float(out["b"][1]) == 2.5
+
+
+def test_empty_root_raises_file_not_found(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        ckpt.load_arrays(str(tmp_path))
+    with pytest.raises(FileNotFoundError):
+        ckpt.load_pytree(str(tmp_path), {"x": np.zeros(1)})
+
+
+# ---------------------------------------------------------------------------
+# torn-newest fallback
+# ---------------------------------------------------------------------------
+
+def test_arrays_torn_newest_falls_back_with_flight_event(tmp_path):
+    root = str(tmp_path)
+    ckpt.save_arrays(root, 1, {"a": np.full(4, 7.0, np.float32)})
+    _corrupt_step(root, 2)
+    before = len([e for e in FLIGHT.snapshot()
+                  if e.kind == "checkpoint-fallback"])
+    out = ckpt.load_arrays(root)
+    np.testing.assert_array_equal(out["a"], 7.0)
+    evs = [e for e in FLIGHT.snapshot() if e.kind == "checkpoint-fallback"]
+    assert len(evs) == before + 1
+    assert evs[-1].fields["bad_step"] == 2
+    assert evs[-1].fields["fell_back_to"] == 1
+
+
+def test_arrays_all_steps_torn_raises(tmp_path):
+    root = str(tmp_path)
+    _corrupt_step(root, 1)
+    _corrupt_step(root, 2)
+    with pytest.raises(Exception):
+        ckpt.load_arrays(root)
+
+
+def test_arrays_explicit_step_still_raises_on_corruption(tmp_path):
+    """An explicit step pins exactness: the caller asked for THAT
+    state, silently handing back an older one would be worse."""
+    root = str(tmp_path)
+    ckpt.save_arrays(root, 1, {"a": np.zeros(2, np.float32)})
+    _corrupt_step(root, 2)
+    with pytest.raises(Exception):
+        ckpt.load_arrays(root, step=2)
+
+
+def test_pytree_torn_newest_falls_back(tmp_path):
+    root = str(tmp_path)
+    tree = {"w": np.full(3, 4.0, np.float32)}
+    ckpt.save_pytree(root, 5, tree)
+    _corrupt_step(root, 6, surface="manifest")
+    out = ckpt.load_pytree(root, tree)
+    np.testing.assert_array_equal(out["w"], 4.0)
+
+
+def test_pytree_leaf_count_mismatch_is_a_caller_error(tmp_path):
+    """A COMPLETE dir with the wrong leaf count is the wrong 'like'
+    tree, not a torn checkpoint — falling back would silently load a
+    different model."""
+    root = str(tmp_path)
+    ckpt.save_pytree(root, 1, {"w": np.zeros(2, np.float32)})
+    ckpt.save_pytree(root, 2, {"w": np.zeros(2, np.float32)})
+    with pytest.raises(ValueError):
+        ckpt.load_pytree(root, {"w": np.zeros(2, np.float32),
+                                "b": np.zeros(1, np.float32)})
+
+
+# ---------------------------------------------------------------------------
+# stale tmp sweeping
+# ---------------------------------------------------------------------------
+
+def test_stale_tmp_dirs_swept_on_next_save(tmp_path):
+    root = str(tmp_path)
+    stale = os.path.join(root, ".ckpt_tmp_deadwriter")
+    os.makedirs(stale)
+    with open(os.path.join(stale, "leaf_00000.npy"), "wb") as f:
+        f.write(b"abandoned")
+    past = time.time() - 2 * ckpt.TMP_SWEEP_AGE_S
+    os.utime(stale, (past, past))
+    fresh = os.path.join(root, ".ckpt_tmp_livewriter")
+    os.makedirs(fresh)  # a concurrent writer's seconds-old tmp
+    ckpt.save_arrays(root, 1, {"a": np.zeros(2, np.float32)})
+    assert not os.path.isdir(stale), "stale tmp survived the sweep"
+    assert os.path.isdir(fresh), "the age gate must spare live writers"
+    # the sweep itself is evidence
+    assert any(e.kind == "checkpoint-sweep" for e in FLIGHT.snapshot())
+
+
+def test_atomic_write_failure_leaves_no_tmp(tmp_path):
+    root = str(tmp_path)
+
+    class Boom(Exception):
+        pass
+
+    def bad_write(tmp):
+        raise Boom()
+
+    with pytest.raises(Boom):
+        ckpt._atomic_write(root, 1, bad_write)
+    assert not [n for n in os.listdir(root) if n.startswith(".ckpt_tmp_")]
+    assert ckpt.latest_step(root) is None
+
+
+def test_manifest_is_strict_json(tmp_path):
+    """The manifest must stay loadable by strict parsers (numpy step
+    scalars arrive from training loops)."""
+    root = str(tmp_path)
+    ckpt.save_pytree(root, np.int64(4), {"w": np.zeros(2, np.float32)})
+    d = os.path.join(root, f"step_{4:012d}")
+    with open(os.path.join(d, "manifest.json")) as f:
+        manifest = json.load(f)
+    assert manifest["step"] == 4 and manifest["n_leaves"] == 1

@@ -1,0 +1,191 @@
+"""cluster/elastic.py — epoch membership, heartbeat liveness, the pure
+member re-split, per-window checkpoints, and the replayability of
+membership transitions (ISSUE 13)."""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from cekirdekler_tpu.cluster.balancer import ClusterLoadBalancer
+from cekirdekler_tpu.cluster.elastic import (
+    Heartbeat,
+    Membership,
+    alive_members,
+    member_resplit,
+    resume_window,
+    save_window,
+)
+from cekirdekler_tpu.obs.decisions import DECISIONS
+from cekirdekler_tpu.obs.replay import replay_record, verify_records
+
+
+# ---------------------------------------------------------------------------
+# the pure re-split
+# ---------------------------------------------------------------------------
+
+def test_member_resplit_matches_lcm_balancer():
+    steps = [256, 128, 128]
+    out = member_resplit(steps, 4096)
+    bal = ClusterLoadBalancer(steps)
+    shares, rem = bal.equal_split(4096)
+    shares = list(shares)
+    shares[0] += rem
+    assert out["ranges"] == shares
+    assert out["lcm"] == bal.lcm
+    assert sum(out["ranges"]) == 4096
+
+
+def test_balancer_resplit_active_masks_departed_nodes():
+    bal = ClusterLoadBalancer([64, 64, 64])
+    out, rem = bal.resplit_active(1920, [0, 2])
+    assert out[1] == 0
+    assert sum(out) + rem == 1920
+    # shares stay step-quantized for the survivors
+    assert out[0] % 64 == 0 and out[2] % 64 == 0
+    with pytest.raises(ValueError):
+        bal.resplit_active(1920, [0, 9])
+    with pytest.raises(ValueError):
+        bal.resplit_active(1920, [])
+
+
+# ---------------------------------------------------------------------------
+# membership epochs & decisions
+# ---------------------------------------------------------------------------
+
+def test_membership_leave_join_bump_epoch_and_record():
+    m = Membership()
+    assert m.establish({"p0": 256, "p1": 128}) == 1
+    out = m.leave("p1", total=2048)
+    assert out["epoch_after"] == 2
+    assert out["ranges"] == member_resplit([256], 2048)["ranges"]
+    out = m.join("p2", 128, total=2048)
+    assert out["epoch_after"] == 3
+    assert m.snapshot()["members"] == {"p0": 256, "p2": 128}
+    recs = [r for r in DECISIONS.snapshot()
+            if r.kind in ("member-leave", "member-join")][-2:]
+    assert [r.kind for r in recs] == ["member-leave", "member-join"]
+    v = verify_records(recs)
+    assert v["ok"], v["first_divergence"]
+
+
+def test_membership_sync_diffs_and_resizes():
+    m = Membership()
+    m.establish({"p0": 256, "p1": 128, "p2": 128})
+    # p2 departs, p1 resizes (leave+join), p3 arrives
+    out = m.sync({"p0": 256, "p1": 256, "p3": 64}, total=4096)
+    snap = m.snapshot()
+    assert snap["members"] == {"p0": 256, "p1": 256, "p3": 64}
+    # p2 leave + p1 leave + p1 rejoin + p3 join = 4 transitions
+    assert len(out) == 4
+    assert snap["epoch"] == 5  # establish(1) + 4 transitions
+
+
+def test_membership_steps_stay_in_process_order_past_ten_members():
+    """Plain lexicographic sort would interleave 'p10' before 'p2':
+    the positional steps_after/ranges in the decision record must
+    follow process order (length-then-lex, the drain lane-key rule)."""
+    m = Membership()
+    m.establish({f"p{i}": 64 * (i + 1) for i in range(11)})
+    out = m.join("p11", 64, total=0)
+    # p0..p10 keep their 64*(i+1) steps positionally, p11 appends
+    assert out["members_after"]["p11"] == 64
+    rec = [r for r in DECISIONS.snapshot()
+           if r.kind == "member-join"][-1]
+    assert rec.inputs["steps_after"] == [64 * (i + 1)
+                                         for i in range(11)] + [64]
+
+
+def test_membership_tampered_resplit_diverges_on_replay():
+    m = Membership()
+    m.establish({"p0": 64, "p1": 64})
+    m.leave("p1", total=1024)
+    rec = [r for r in DECISIONS.snapshot()
+           if r.kind == "member-leave"][-1]
+    row = rec.to_row()
+    out = replay_record(row)
+    assert out["ok"] is True
+    row["outputs"] = dict(row["outputs"], ranges=[512])
+    out = replay_record(row)
+    assert out["ok"] is False and "ranges" in out["mismatch"]
+
+
+# ---------------------------------------------------------------------------
+# heartbeats
+# ---------------------------------------------------------------------------
+
+def test_heartbeat_liveness_and_stale_detection(tmp_path):
+    root = str(tmp_path)
+    hb0 = Heartbeat(root, "p0", interval_s=0.05)
+    hb1 = Heartbeat(root, "p1", interval_s=0.05, start=False)
+    try:
+        assert alive_members(root, timeout_s=5.0) == ["p0", "p1"]
+        # p1 stops beating (a SIGKILL leaves exactly this): backdate its
+        # file instead of sleeping the timeout out
+        hb1.close()
+        path = os.path.join(root, "hb_p1")
+        past = time.time() - 60.0
+        os.utime(path, (past, past))
+        assert alive_members(root, timeout_s=1.0) == ["p0"]
+        # a CLEAN leave retracts the file entirely
+        hb0.close(remove=True)
+        assert alive_members(root, timeout_s=1.0) == []
+    finally:
+        hb0.close()
+        hb1.close()
+
+
+def test_heartbeat_drives_membership_sync(tmp_path):
+    """The detection half of preemption: a stale heartbeat reconciles
+    into a recorded member-leave."""
+    root = str(tmp_path)
+    m = Membership()
+    m.establish({"p0": 64, "p1": 64})
+    hb0 = Heartbeat(root, "p0", start=False)
+    hb1 = Heartbeat(root, "p1", start=False)
+    hb1.close()
+    past = time.time() - 60.0
+    os.utime(os.path.join(root, "hb_p1"), (past, past))
+    present = {mid: 64 for mid in alive_members(root, timeout_s=1.0)}
+    out = m.sync(present, total=1024)
+    assert len(out) == 1
+    assert m.snapshot()["members"] == {"p0": 64}
+    assert out[0]["ranges"] == [1024]
+    hb0.close()
+
+
+# ---------------------------------------------------------------------------
+# per-window checkpoints
+# ---------------------------------------------------------------------------
+
+def test_save_resume_window_round_trip_with_metadata(tmp_path):
+    root = str(tmp_path)
+    y = np.arange(8, dtype=np.float32)
+    save_window(root, 3, {"y": y}, member_steps=[128, 64])
+    save_window(root, 4, {"y": y * 2}, member_steps=[128, 64])
+    state = resume_window(root)
+    assert state["window"] == 4
+    np.testing.assert_array_equal(state["arrays"]["y"], y * 2)
+    assert state["member_steps"] == [128, 64]
+    # the restore is provenance: a checkpoint-restore decision recorded
+    recs = [r for r in DECISIONS.snapshot()
+            if r.kind == "checkpoint-restore"]
+    assert recs and recs[-1].outputs["window"] == 4
+
+
+def test_resume_window_falls_back_past_torn_newest(tmp_path):
+    root = str(tmp_path)
+    save_window(root, 1, {"y": np.full(4, 9.0, np.float32)},
+                member_steps=[64])
+    torn = os.path.join(root, f"step_{2:012d}")
+    os.makedirs(torn)
+    with open(os.path.join(torn, "arrays.npz"), "wb") as f:
+        f.write(b"garbage")
+    state = resume_window(root)
+    assert state["window"] == 1
+    np.testing.assert_array_equal(state["arrays"]["y"], 9.0)
+
+
+def test_resume_window_empty_root_is_fresh_start(tmp_path):
+    assert resume_window(str(tmp_path / "nope")) is None

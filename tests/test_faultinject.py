@@ -1,0 +1,335 @@
+"""Chaos suite (ISSUE 13): the seeded fault-injection plane
+(utils/faultinject.py) and the recovery behaviors it proves.
+
+Contract under test: every injected fault either leaves results
+BIT-IDENTICAL (delay-shaped faults — slow links, lane stalls — plus
+the recovery machinery) or surfaces as a NAMED, non-hanging error
+(submit failures, exhausted retries); the same plan string + seed
+reproduces the same fault sequence; and every injected fault lands as
+a ``fault-injected`` flight event + ``ck_fault_injected_total`` metric
+so postmortems and these tests read one evidence stream.
+
+The DCN process-kill scenario lives in tests/test_dcn.py
+(``test_kill_and_rejoin_converges_bit_identical``) — it needs real
+process lifecycle."""
+
+import importlib.util
+import os
+import time
+
+import numpy as np
+import pytest
+
+from cekirdekler_tpu import ClArray
+from cekirdekler_tpu.core import NumberCruncher
+from cekirdekler_tpu.errors import (
+    ClusterRetryExhausted,
+    InjectedFaultError,
+)
+from cekirdekler_tpu.hardware import platforms
+from cekirdekler_tpu.metrics.registry import REGISTRY
+from cekirdekler_tpu.obs.flight import FLIGHT
+from cekirdekler_tpu.utils.faultinject import (
+    FAULTS,
+    FaultPlane,
+    parse_plan,
+)
+
+INC = """
+__kernel void inc(__global float* a) {
+    int i = get_global_id(0);
+    a[i] = a[i] + 1.0f;
+}
+"""
+
+
+@pytest.fixture(scope="module")
+def devs():
+    return platforms().cpus()
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    FAULTS.disarm()
+    yield
+    FAULTS.disarm()
+
+
+def _load_resilience():
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "ck_resilience_test", os.path.join(here, "tools", "resilience.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ---------------------------------------------------------------------------
+# plan grammar & determinism
+# ---------------------------------------------------------------------------
+
+def test_plan_parses_points_selectors_params():
+    seed, clauses = parse_plan(
+        "seed=9;slow-link@lane1:factor=5,times=8;"
+        "socket-drop@recv:after=2;driver-submit")
+    assert seed == 9
+    assert [c.point for c in clauses] == [
+        "slow-link", "socket-drop", "driver-submit"]
+    assert clauses[0].lane == 1 and clauses[0].factor == 5.0
+    assert clauses[0].times == 8
+    assert clauses[1].selector == "recv" and clauses[1].after == 2
+    assert clauses[2].selector is None
+
+
+def test_plan_rejects_bad_grammar():
+    with pytest.raises(ValueError):
+        parse_plan("not-a-point:delay_ms=1")
+    with pytest.raises(ValueError):
+        parse_plan("lane-stall:bogus_param=1")
+    # an armed-but-ignored plan would be the worst chaos-rig failure
+    with pytest.raises(ValueError):
+        parse_plan("lane-stall:delay_ms")
+
+
+def test_after_times_counting_is_exact():
+    p = FaultPlane()
+    p.arm("lane-stall@lane0:delay_ms=1,after=2,times=3")
+    fired = [p.fire("lane-stall", lane=0) is not None for _ in range(8)]
+    assert fired == [False, False, True, True, True, False, False, False]
+    # non-matching lane never consumes the clause's budget
+    assert p.fire("lane-stall", lane=1) is None
+
+
+def test_probabilistic_fires_are_seed_deterministic():
+    def pattern(seed: int) -> list[bool]:
+        p = FaultPlane()
+        p.arm(f"seed={seed};lane-stall@lane0:delay_ms=1,p=0.5")
+        return [p.fire("lane-stall", lane=0) is not None
+                for _ in range(64)]
+
+    a, b = pattern(42), pattern(42)
+    assert a == b                       # same seed = same fault sequence
+    assert any(a) and not all(a)        # p=0.5 genuinely mixes
+    assert pattern(43) != a             # the seed is load-bearing
+
+
+def test_env_arming_and_disarm():
+    os.environ["CK_FAULTS"] = "lane-stall@lane0:delay_ms=1,times=1"
+    try:
+        p = FaultPlane()
+        assert p.enabled and p.plan
+        p.disarm()
+        assert not p.enabled
+        assert p.fire("lane-stall", lane=0) is None
+    finally:
+        os.environ.pop("CK_FAULTS", None)
+
+
+def test_fired_fault_is_evidence():
+    """Every injection lands as a flight event + metric (one stream)."""
+    c0 = REGISTRY.counter(
+        "ck_fault_injected_total",
+        "deliberately injected faults (utils/faultinject.py)",
+        point="lane-stall").value
+    FAULTS.arm("lane-stall@lane3:delay_ms=2,times=1")
+    d = FAULTS.delay_s("lane-stall", lane=3)
+    assert d == pytest.approx(0.002)
+    c1 = REGISTRY.counter(
+        "ck_fault_injected_total",
+        "deliberately injected faults (utils/faultinject.py)",
+        point="lane-stall").value
+    assert c1 == c0 + 1
+    evs = [e for e in FLIGHT.snapshot() if e.kind == "fault-injected"]
+    assert evs and evs[-1].fields["point"] == "lane-stall"
+    assert evs[-1].fields["lane"] == 3
+    snap = FAULTS.snapshot()
+    assert snap["clauses"][0]["fired"] == 1
+
+
+# ---------------------------------------------------------------------------
+# driver-submit: named, non-hanging error at the sync point
+# ---------------------------------------------------------------------------
+
+def test_driver_submit_fault_surfaces_named_at_sync_point(devs):
+    cr = NumberCruncher(devs.subset(2), INC)
+    x = ClArray(np.zeros(1024, np.float32), name="x")
+    x.partial_read = True
+    cr.enqueue_mode = True
+    # let the fused window engage cleanly first
+    for _ in range(3):
+        x.compute(cr, 1, "inc", 1024, 64)
+    FAULTS.arm("driver-submit@lane0:times=1")
+    with pytest.raises(InjectedFaultError) as ei:
+        # deferrals dispatch in fused_batch batches; keep calling until
+        # the poisoned submit surfaces (bounded — named error, no hang)
+        for _ in range(64):
+            x.compute(cr, 1, "inc", 1024, 64)
+        cr.barrier()
+    assert ei.value.point == "driver-submit"
+    FAULTS.disarm()
+    cr.dispose()
+
+
+# ---------------------------------------------------------------------------
+# slow link: Nx degradation, bit-identical results
+# ---------------------------------------------------------------------------
+
+def test_slow_link_injection_keeps_results_bit_identical(devs):
+    cr = NumberCruncher(devs.subset(2), INC)
+    x = ClArray(np.zeros(1024, np.float32), name="x")
+    x.partial_read = True
+    FAULTS.arm("seed=3;slow-link@lane1:factor=4,delay_ms=2,times=12")
+    cr.enqueue_mode = True
+    iters = 10
+    for _ in range(iters):
+        x.compute(cr, 1, "inc", 1024, 64)
+    cr.barrier()
+    cr.enqueue_mode = False  # flush (its D2H drain is also instrumented)
+    FAULTS.disarm()
+    np.testing.assert_array_equal(np.asarray(x), float(iters))
+    evs = [e for e in FLIGHT.snapshot()
+           if e.kind == "fault-injected"
+           and e.fields.get("point") == "slow-link"]
+    assert evs, "slow-link never fired through the transfer funnels"
+    assert all(e.fields["lane"] == 1 for e in evs)
+    cr.dispose()
+
+
+# ---------------------------------------------------------------------------
+# lane stall -> automatic drain -> readmit (the closed loop, seeded)
+# ---------------------------------------------------------------------------
+
+def test_seeded_stall_is_drained_and_readmitted_exactly(devs):
+    """The acceptance loop (ISSUE 13): an injected lane degradation is
+    drained automatically (share redistributed, workload exact) and the
+    lane is re-admitted after the injection clears — no human
+    intervention, no flapping.  Runs the same scenario the bench's
+    ``resilience`` section ships (tools/resilience.py)."""
+    res = _load_resilience().drain_readmit_scenario(
+        devs, stall_ms=400.0, max_windows=40)
+    assert res.get("skipped") is None, res
+    assert res["windows_to_drain"] is not None, res
+    assert res["drain_recover_ms"] is not None
+    assert res["ranges_after_drain"][1] == 0, res
+    assert res["windows_to_readmit"] is not None, res
+    assert res["drain_report"]["states"] == {"0": "active", "1": "active"}
+    # exactly one drain and one readmit: no flapping
+    assert res["drain_report"]["drains"] == 1
+    assert res["drain_report"]["readmits"] == 1
+    assert res["exact"], res
+
+
+# ---------------------------------------------------------------------------
+# socket drop: reconnect + idempotent retry / named exhaustion
+# ---------------------------------------------------------------------------
+
+def _cluster_pair(devs):
+    from cekirdekler_tpu.cluster.client import CruncherClient
+    from cekirdekler_tpu.cluster.server import CruncherServer
+
+    server = CruncherServer(devices=devs.subset(1))
+    client = CruncherClient(
+        server.host, server.port, op_timeout=10.0,
+        max_retries=3, backoff_s=0.01, backoff_max_s=0.05)
+    return server, client
+
+
+def test_socket_drop_mid_message_is_survived_by_reconnect(devs):
+    server, client = _cluster_pair(devs)
+    try:
+        client.setup(INC)
+        x = ClArray(np.zeros(256, np.float32))
+        x.partial_read = True
+        # drop the NEXT send mid-message, exactly once
+        FAULTS.arm("socket-drop@send:times=1")
+        client.compute(["inc"], [x], 5, 0, 256, 64)
+        FAULTS.disarm()
+        assert client.reconnects == 1
+        np.testing.assert_array_equal(x.host(), 1.0)
+        # and the connection is healthy again afterwards
+        client.compute(["inc"], [x], 5, 0, 256, 64)
+        np.testing.assert_array_equal(x.host(), 2.0)
+    finally:
+        FAULTS.disarm()
+        client.close()
+        server.stop()
+
+
+def test_retry_reuses_the_request_sequence_number(devs):
+    """Idempotency marker: the retried message carries the SAME seq it
+    was first sent with — a dedup-aware peer can recognize a replay."""
+    from cekirdekler_tpu.cluster.netbuffer import Command, Message
+
+    server, client = _cluster_pair(devs)
+    try:
+        msg = Message(Command.CONTROL)
+        FAULTS.arm("socket-drop@send:times=1")
+        reply = client._roundtrip(msg)
+        FAULTS.disarm()
+        assert reply.command == Command.ANSWER_CONTROL
+        assert client.reconnects == 1
+        assert msg.meta["seq"] == 1      # assigned once, reused on retry
+        assert client._seq == 1          # no fresh seq burned by the retry
+    finally:
+        FAULTS.disarm()
+        client.close()
+        server.stop()
+
+
+def test_dead_node_raises_named_error_not_a_hang(devs):
+    server, client = _cluster_pair(devs)
+    try:
+        client.setup(INC)
+        server.stop()
+        t0 = time.perf_counter()
+        with pytest.raises(ClusterRetryExhausted) as ei:
+            client.num_devices()
+        wall = time.perf_counter() - t0
+        assert ei.value.attempts == 4
+        assert wall < 10.0  # bounded backoff, not a hang
+    finally:
+        client.close()
+        server.stop()
+
+
+def test_mid_recv_death_times_out_instead_of_hanging(devs):
+    """The seed behavior this PR removes: a server dying mid-reply hung
+    the client forever (only CONNECT had a timeout).  Now the
+    per-operation read timeout surfaces it, the retries run, and the
+    client ends with a NAMED error."""
+    import socket as socketlib
+
+    # a listener that accepts and then sends HALF a header, forever
+    lst = socketlib.socket()
+    lst.bind(("127.0.0.1", 0))
+    lst.listen(4)
+    port = lst.getsockname()[1]
+    import threading
+
+    def half_replier():
+        while True:
+            try:
+                conn, _ = lst.accept()
+            except OSError:
+                return
+            try:
+                conn.recv(1 << 20)  # swallow the request
+                conn.sendall(b"\x01")  # half a header, then silence
+            except OSError:
+                pass
+
+    t = threading.Thread(target=half_replier, daemon=True)
+    t.start()
+    from cekirdekler_tpu.cluster.client import CruncherClient
+
+    try:
+        client = CruncherClient(
+            "127.0.0.1", port, op_timeout=0.2, max_retries=1,
+            backoff_s=0.01, backoff_max_s=0.02)
+        t0 = time.perf_counter()
+        with pytest.raises(ClusterRetryExhausted):
+            client.num_devices()
+        assert time.perf_counter() - t0 < 5.0
+        client.close()
+    finally:
+        lst.close()

@@ -57,6 +57,13 @@ HOT_ROOTS = (
     # sight, and only the allowlisted frontend/table locks may be taken
     "serve.frontend.ServeFrontend.submit",
     "serve.admission.AdmissionController.check",
+    # the fault-injection plane (ISSUE 13): fire() is reached from the
+    # driver-queue submit path — every instrumented site guards with
+    # `if FAULTS.enabled:` and the per-point metric handles are cached
+    # at arm time, so the disabled plane costs one attribute read
+    "utils.faultinject.FaultPlane.fire",
+    "utils.faultinject.FaultPlane.delay_s",
+    "utils.faultinject.FaultPlane.raise_if_fired",
 )
 
 #: Locks the hot path may take: the scheduler lock + fused-window mutex
@@ -77,6 +84,9 @@ HOT_LOCK_ALLOW = (
     "serve.frontend.ServeFrontend._mu",
     "serve.tenants.TenantTable._mu",
     "serve.admission.AdmissionController._mu",
+    # fault plane: taken ONLY when an armed clause matches the point —
+    # test/chaos rigs; the disabled fast path never reaches it
+    "utils.faultinject.FaultPlane._mu",
 )
 
 

@@ -95,6 +95,13 @@ WATCHED_KEYS = (
     ("serve_p99_ms", (), "lower", 0.40),
     ("serve_goodput_rps", (), "higher", 0.25),
     ("serve_coalesce_ratio", (), "higher", 0.20),
+    # recovery tier (ISSUE 13, bench section "resilience"): wall from an
+    # injected degradation's first barrier to the drain taking effect
+    # (lower is better), and windows for a kill-resume run to reconverge
+    # its share split (lower is better).  Floors are wide: both ride
+    # sleep-scale injections on a contended CPU container
+    ("drain_recover_ms", (), "lower", 0.50),
+    ("rejoin_converge_iters", (), "lower", 0.50),
 )
 
 #: Trajectory-noise widening: tolerance = max(floor, NOISE_K * CV).
@@ -117,6 +124,8 @@ KEY_SECTION = {
     "serve_p99_ms": "serving",
     "serve_goodput_rps": "serving",
     "serve_coalesce_ratio": "serving",
+    "drain_recover_ms": "resilience",
+    "rejoin_converge_iters": "resilience",
 }
 
 
