@@ -812,6 +812,27 @@ def finalize_result(result: dict, sched: "SectionScheduler") -> dict:
         replay_ok = None
     if isinstance(result.get("headline"), dict):
         result["headline"]["replay_ok"] = replay_ok
+    # bounded model check (ISSUE 14): the quick-profile exhaustive
+    # exploration of the four controller machines — sub-second, and
+    # AFTER the metrics snapshot like the replay pass (exploration
+    # re-executes emission sites that touch ck_balance_*/ck_member_*
+    # counters; those echoes must not land in the artifact's metrics
+    # block).  model_ok rides the headline so tools/regress.py (and
+    # the truncated-tail recovery) can hard-fail a run whose
+    # controllers stopped satisfying their declared invariants.
+    try:
+        from cekirdekler_tpu.analysis.model import tier1_check
+
+        result["model"] = tier1_check(quick=True)
+        model_ok = result["model"].get("ok")
+        model_states = result["model"].get("states_explored")
+    except Exception as e:  # noqa: BLE001 - resilience boundary
+        result["model"] = {"error": f"{type(e).__name__}: {e}"[:200]}
+        model_ok = None
+        model_states = None
+    if isinstance(result.get("headline"), dict):
+        result["headline"]["model_ok"] = model_ok
+        result["headline"]["model_states_explored"] = model_states
     try:
         here = os.path.dirname(os.path.abspath(__file__))
         regression = _load_regress().bench_epilogue(result, repo_root=here)
@@ -835,6 +856,9 @@ def finalize_result(result: dict, sched: "SectionScheduler") -> dict:
         # the degraded/headline-less artifact still carries the
         # replay-verify verdict (the sentinel gates on it)
         headline["replay_ok"] = replay_ok
+    if "model_ok" not in headline:
+        headline["model_ok"] = model_ok
+        headline["model_states_explored"] = model_states
     result["headline"] = headline
     return result
 

@@ -36,6 +36,14 @@ correctness anchor is the differential oracle in
 ``tests/kernel_corpus.py``: every verdict is checked against ground
 truth by running each corpus kernel split across virtual lanes vs
 unsplit and comparing bit-exactly.
+
+The package's other analyzer, :mod:`.model` (``tools/ckmodel``), is
+deliberately NOT imported here: it is the bounded exhaustive model
+checker for the pure controller state machines, and it imports the
+LIVE runtime (driving the real `drain_transition`/`Membership`/
+`admit_decision`/`plan_coalesce`/`load_balance` is its whole point) —
+keeping it out of this namespace preserves ckprove's jax-free
+stub-load path.
 """
 
 from .interp import AV, Access, KernelSummary, summarize_kernel

@@ -51,6 +51,7 @@ from .balancer import ClusterLoadBalancer
 __all__ = [
     "Membership",
     "member_resplit",
+    "MODEL_INVARIANTS",
     "Heartbeat",
     "alive_members",
     "save_window",
@@ -62,6 +63,34 @@ __all__ = [
 #: window index and member-step table ride the same atomic .npz as the
 #: partition arrays — one rename, one unit of consistency).
 META_PREFIX = "_ck_meta_"
+
+#: Machine-checked temporal invariants of the elastic-membership
+#: machine (the ``MODEL_INVARIANTS`` contract — see ``obs/drain.py``):
+#: ``analysis/model.py`` drives a REAL :class:`Membership` through
+#: every leave/join/timeout interleaving over a small roster alphabet
+#: (ids chosen to exercise the length-then-lex order) and checks each
+#: captured ``member-leave``/``member-join`` record against these.
+MODEL_INVARIANTS = (
+    ("epoch-monotone", "safety",
+     "every membership transition bumps the epoch by exactly one — "
+     "epochs are strictly monotone across any interleaving"),
+    ("resplit-conservation", "safety",
+     "member_resplit ranges sum exactly to the total: a membership "
+     "change never loses or invents work (remainder folded, not "
+     "dropped)"),
+    ("resplit-quantized", "safety",
+     "every member's re-split share is a non-negative LCM-chunk "
+     "multiple; only member 0 (the mainframe rule) may carry the "
+     "sub-LCM remainder"),
+    ("sync-converges", "liveness",
+     "Membership.sync reconciles to exactly the observed roster in "
+     "one call — departures recorded before arrivals, a step change "
+     "recorded as leave+join, nothing left behind"),
+    ("deterministic-order", "safety",
+     "the same (roster, observation) diff always records the same "
+     "transition sequence — length-then-lex member order, so a "
+     "10-member roster cannot reorder the decision log"),
+)
 
 
 def member_resplit(steps: list, total: int) -> dict:

@@ -36,6 +36,7 @@ __all__ = [
     "per_iteration_benches",
     "DAMPING",
     "HISTORY_DEPTH",
+    "MODEL_INVARIANTS",
 ]
 
 DAMPING = 0.3        # reference: HelperFunctions.cs:246
@@ -51,6 +52,48 @@ DAMP_GROW = 1.25     # on consistent direction
 #: recorded log re-executed after someone edits this constant fails
 #: naming the first divergent seq).
 FREEZE_MARGIN = 0.6
+#: Sum-repair tie band (relative): when granting a leftover step, all
+#: chips whose share is within this fraction of the best are treated
+#: as tied and the step goes to the INCUMBENT (largest current range).
+#: Found by the bounded model checker (tools/ckmodel, ISSUE 14): with
+#: two equal-rate chips plus one slower chip, the ``(range_i + 1)``
+#: term hands the currently-SMALLER chip an epsilon-higher share, so a
+#: strict argmax flips the repair step between the pair every
+#: iteration — a permanent ±1-step swap limit cycle (re-shard +
+#: re-upload churn each window) that the quantization freeze cannot
+#: catch because the slow chip drags the mean down.  The band must
+#: cover the +1 distortion (≤ one part in range_items ≈ 8e-3 at the
+#: 128-step/3072-total bound) and stay far below genuine rate
+#: differences (the alphabet's closest pair differs ~30%); the
+#: counterexample trace is pinned in tests/fixtures_decisions/.
+REPAIR_TIE_BAND = 0.02
+
+#: Machine-checked temporal invariants of the balancer freeze/jump
+#: machine (the ``MODEL_INVARIANTS`` contract — see ``obs/drain.py``):
+#: ``analysis/model.py`` runs :func:`load_balance` down every
+#: rate-consistent trajectory from a small quantized rate alphabet ×
+#: knob grid (jump, smoothing, transfer floors), capturing the REAL
+#: ``load-balance`` decision records each step, and proves each of
+#: these over every visited state.
+MODEL_INVARIANTS = (
+    ("range-conservation", "safety",
+     "every iteration's ranges sum exactly to the total — the "
+     "sum-repair loop never loses or invents work"),
+    ("range-quantized", "safety",
+     "every range is a non-negative multiple of step at every "
+     "iteration"),
+    ("jump-one-shot", "safety",
+     "at most one undamped jump per BalanceState lifetime, and only "
+     "after the arming iteration (never on first-window benches)"),
+    ("freeze-legal", "safety",
+     "a freeze only ever holds a step-aligned split (the pipeline "
+     "mode-change rule: holding is illegal when step changed under "
+     "the held table)"),
+    ("converges", "liveness",
+     "for every rate-consistent trajectory in the alphabet the split "
+     "settles within the bound and stays — no limit cycle survives "
+     "the adaptive damping + quantization freeze"),
+)
 
 
 @dataclass
@@ -402,8 +445,15 @@ def load_balance(
     while diff != 0 and guard < 1_000_000:
         guard += 1
         if diff > 0:
-            # grant a step to the fastest (highest share) chip
-            i = max(range(n), key=lambda k: shares[k])
+            # grant a step to the fastest (highest share) chip; chips
+            # within REPAIR_TIE_BAND of the best are TIED and the step
+            # stays with the incumbent (largest current range) — a
+            # strict argmax limit-cycles on equal-rate chips (see the
+            # REPAIR_TIE_BAND note; ckmodel counterexample)
+            smax = max(shares)
+            cands = [k for k in range(n)
+                     if shares[k] >= smax * (1.0 - REPAIR_TIE_BAND)]
+            i = max(cands, key=lambda k: (ranges[k], shares[k]))
             quant[i] += step
             diff -= step
         else:

@@ -78,6 +78,7 @@ import json
 import os
 import time
 from collections import deque
+from contextlib import contextmanager
 from typing import Any, NamedTuple
 
 __all__ = [
@@ -86,6 +87,7 @@ __all__ = [
     "DECISIONS",
     "DECISION_KINDS",
     "REPLAYABLE_KINDS",
+    "CONTEXT_KINDS",
     "DECISION_LOG_ENV",
     "load_decision_log",
 ]
@@ -120,6 +122,21 @@ REPLAYABLE_KINDS = (
     "load-balance", "transfer-choose", "transfer-observe", "health-verdict",
     "admission", "coalesce",
     "drain-apply", "readmit", "member-leave", "member-join",
+)
+
+#: The complement, DECLARED: every decision kind is placed in exactly
+#: one bucket on purpose.  A kind in neither tuple would silently skip
+#: ``ckreplay verify`` (an "unregistered kind" looks identical to a
+#: deliberately context-only one) — ``tools/lint_obs.py`` fails CI
+#: unless REPLAYABLE_KINDS ∪ CONTEXT_KINDS == DECISION_KINDS exactly,
+#: and cross-checks the replayer registry in ``obs/replay.py`` against
+#: REPLAYABLE_KINDS both ways.
+CONTEXT_KINDS = (
+    "fused-engage",        # depends on live device residency
+    "fused-disengage",     # depends on live device residency
+    "drain-advisory",      # derived view of the monitor's verdicts
+    "scheduler-rotation",  # derived from on-disk artifact history
+    "checkpoint-restore",  # reads the filesystem: provenance, not oracle
 )
 
 #: Spill-buffer bound: the armed jsonl accumulation is capped so a
@@ -234,6 +251,33 @@ class DecisionLog:
     def snapshot(self) -> list[DecisionRecord]:
         """Recorded decisions, oldest first (one-slice ring copy)."""
         return list(self._ring)
+
+    @contextmanager
+    def capture(self):
+        """Route records into a scratch ring and yield it: the pure-
+        function seam the bounded model checker
+        (``cekirdekler_tpu/analysis/model.py``) needs — exploring a
+        controller's state space re-executes its REAL emission sites
+        thousands of times, and those records must neither evict the
+        live ring's history nor land in an armed spill.  The live
+        ring, spill buffer, watermark and ``total_recorded`` are saved
+        and restored; ``seq`` keeps advancing globally (captured rows
+        are renumbered by their consumer).  Process-global like
+        :func:`~.replay._quiesced` — run captures at sync points
+        (bench runs the model check in ``finalize_result``, after
+        every section's workload has completed)."""
+        saved = (self._ring, self._spill, self._spill_seen, self._total,
+                 self.enabled)
+        scratch: deque[DecisionRecord] = deque(maxlen=self._cap)
+        self._ring = scratch
+        self._spill = deque(maxlen=SPILL_MAX)
+        self._spill_seen = 0
+        self.enabled = True
+        try:
+            yield scratch
+        finally:
+            (self._ring, self._spill, self._spill_seen, self._total,
+             self.enabled) = saved
 
     def clear(self) -> None:
         self._ring.clear()

@@ -50,11 +50,45 @@ __all__ = [
     "LANE_ACTIVE",
     "LANE_QUARANTINED",
     "LANE_PROBATION",
+    "MODEL_INVARIANTS",
 ]
 
 LANE_ACTIVE = "active"
 LANE_QUARANTINED = "quarantined"
 LANE_PROBATION = "probation"
+
+#: Machine-checked temporal invariants of the drain state machine
+#: (``(id, kind, statement)`` — kind is ``safety`` or ``liveness``).
+#: Declared NEXT to the machine they bind (the ``MODEL_INVARIANTS``
+#: contract): ``cekirdekler_tpu/analysis/model.py`` explores the
+#: product state space of :func:`drain_transition` ×
+#: :func:`apply_quarantine` under small bounds and proves each of
+#: these over every reachable state — the properties PR 12's review
+#: found violated by hand (probation↔quarantine flapping) are CI
+#: failures now, not review folklore.  ``tools/ckmodel`` asserts the
+#: checker implements exactly this list.
+MODEL_INVARIANTS = (
+    ("availability-floor", "safety",
+     "the last active lane is never drained — every reachable state "
+     "keeps at least one lane active"),
+    ("share-conservation", "safety",
+     "apply_quarantine preserves the range-table total exactly under "
+     "every reachable drain/probation mask"),
+    ("quarantine-masked", "safety",
+     "a quarantined lane's masked share is 0; a probation lane's is "
+     "exactly one step (the probe)"),
+    ("action-visibility", "safety",
+     "every lane whose state changed this barrier appears in "
+     "drained/readmitted/probed — no silent transition (flapping is "
+     "visible on every evidence stream)"),
+    ("eventual-readmission", "liveness",
+     "under sustained ok verdicts (fairness: the lane genuinely "
+     "recovered) every non-active lane is readmitted within "
+     "hold_barriers + confirm_clear + 1 barriers"),
+    ("no-silent-flap", "liveness",
+     "no all-ok barrier ever drains a lane: a quarantine↔probation "
+     "cycle requires fresh degraded evidence at every relapse"),
+)
 
 
 def drain_transition(

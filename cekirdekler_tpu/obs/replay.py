@@ -62,6 +62,8 @@ __all__ = [
     "convergence_summary",
     "bench_decisions_summary",
     "decisionz_payload",
+    "verify_counterexample",
+    "save_counterexample",
     "WHATIF_KNOBS",
 ]
 
@@ -463,6 +465,54 @@ def verify_records(records, max_divergences: int = 8) -> dict:
         "divergences": divergences,
         "divergences_truncated": divergent > len(divergences),
     }
+
+
+# ---------------------------------------------------------------------------
+# the counterexample→replay bridge (tools/ckmodel)
+# ---------------------------------------------------------------------------
+
+def _counterexample_trace(violation) -> list[dict]:
+    """Trace rows from a ckmodel violation (object, ``to_row()`` dict,
+    or a bare row list)."""
+    if isinstance(violation, (list, tuple)):
+        return list(violation)
+    trace = getattr(violation, "trace", None)
+    if trace is None and isinstance(violation, dict):
+        trace = violation.get("trace")
+    return list(trace or ())
+
+
+def verify_counterexample(violation) -> dict:
+    """Replay a model-checker counterexample TRACE through the live
+    code path — the bridge the bounded model checker
+    (``cekirdekler_tpu/analysis/model.py``) emits its violations for.
+
+    Traces are sequences of decision records in the standard row
+    schema, so this is :func:`verify_records` with the violation
+    unwrapped.  Two uses, both pinned by tests:
+
+    - a counterexample from the REAL controllers (e.g. a true liveness
+      violation found on HEAD) replays ``ok: True`` — the trace is a
+      faithful execution, and committing it as a fixture pins the
+      fixed behavior as a regression test;
+    - a counterexample from a deliberately-broken fixture machine
+      diverges naming the first seq where the broken outputs part
+      from the real functions — the same drill ``ckreplay verify``
+      runs on a tampered log."""
+    return verify_records(_counterexample_trace(violation))
+
+
+def save_counterexample(path: str, violation) -> str:
+    """Spill one counterexample as a ``ck-decision-log-v1`` jsonl (the
+    decision log's own format, tmp+rename): ``ckreplay verify <path>``
+    re-executes it and ``ckreplay explain <path>`` renders the
+    causality table of a balance trace — no ckmodel-specific reader
+    anywhere downstream."""
+    from .decisions import DecisionRecord, _write_jsonl
+
+    rows = [DecisionRecord.from_row(r)
+            for r in _counterexample_trace(violation)]
+    return _write_jsonl(path, rows, dropped=0, total=len(rows))
 
 
 # ---------------------------------------------------------------------------

@@ -49,6 +49,7 @@ __all__ = [
     "ServeRejected",
     "TenantQuota",
     "admit_decision",
+    "MODEL_INVARIANTS",
     "REJECT_HEALTH",
     "REJECT_QUEUE",
     "REJECT_QUOTA",
@@ -68,6 +69,33 @@ REJECT_KERNEL = "kernel-unsafe"
 #: Floor for retry-after hints: even an instant-drain tier should not
 #: invite a reject/retry busy-loop.
 _RETRY_FLOOR_S = 0.005
+
+#: Machine-checked temporal invariants of the admission machine (the
+#: ``MODEL_INVARIANTS`` contract — see ``obs/drain.py``):
+#: ``analysis/model.py`` explores the product of per-tenant in-flight
+#: counts × queue depth × health flips under small bounds, driving
+#: :func:`admit_decision` at every submit exactly as the frontend
+#: does, and proves each of these over every reachable state.
+MODEL_INVARIANTS = (
+    ("quota-exact", "safety",
+     "admission never lets a tenant's in-flight count exceed its "
+     "quota — the exact-under-contention contract, proved over every "
+     "interleaving of submits and completions"),
+    ("queue-bounded", "safety",
+     "the global queue never exceeds max_queue_depth: backpressure "
+     "sheds load before latency collapses"),
+    ("reject-order", "safety",
+     "rejection reasons follow the pinned check order — kernel "
+     "soundness, then health, then queue depth, then tenant quota; a "
+     "reject names the FIRST failing gate"),
+    ("retry-hint", "safety",
+     "every backoff-able rejection carries retry_after_s >= the "
+     "anti-busy-loop floor; kernel-unsafe carries exactly 0.0 (no "
+     "backoff makes a refuted kernel admissible)"),
+    ("admit-iff", "safety",
+     "admit is exactly the conjunction of the four gates: no hidden "
+     "input changes the verdict, no gate is skipped"),
+)
 
 
 class ServeRejected(CekirdeklerError):

@@ -109,3 +109,70 @@ def test_metrics_dump_watch_requires_url():
     md = _load("ck_metrics_dump2", "tools/metrics_dump.py")
     with pytest.raises(SystemExit):
         md.main(["--watch", "1"])
+
+
+# ---------------------------------------------------------------------------
+# replayer registry cross-check (ISSUE 14 satellite)
+# ---------------------------------------------------------------------------
+
+def test_replayer_registry_clean_on_head():
+    assert lint.replayer_problems() == []
+
+
+def test_replayer_kinds_parsed_statically_match_import():
+    from cekirdekler_tpu.obs import replay
+
+    assert lint.code_replayer_kinds() == set(replay._REPLAYERS)
+
+
+def test_replayable_and_context_partition_decision_kinds():
+    from cekirdekler_tpu.obs.decisions import (
+        CONTEXT_KINDS,
+        DECISION_KINDS,
+        REPLAYABLE_KINDS,
+    )
+
+    assert set(REPLAYABLE_KINDS) | set(CONTEXT_KINDS) == \
+        set(DECISION_KINDS)
+    assert not set(REPLAYABLE_KINDS) & set(CONTEXT_KINDS)
+
+
+def test_replayer_drift_fixtures_are_caught():
+    """The motivating failure: a decision kind in NEITHER bucket
+    silently skipped verification; a replayable kind without a
+    registered replayer did too.  Both are findings now."""
+    decisions_src = (
+        'DECISION_KINDS = ("a", "b", "c")\n'
+        'REPLAYABLE_KINDS = ("a",)\n'
+        'CONTEXT_KINDS = ("b",)\n'
+    )
+    replay_src = "_REPLAYERS = {\n    \"a\": _replay_a,\n}\n"
+    assert lint.replayer_problems(decisions_src, replay_src) == [
+        "decision kind 'c' is in neither REPLAYABLE_KINDS nor "
+        "CONTEXT_KINDS — place it deliberately (a kind in neither "
+        "bucket silently skips verification)",
+    ]
+    # a replayable kind with no registered replayer
+    missing = lint.replayer_problems(
+        decisions_src.replace('REPLAYABLE_KINDS = ("a",)',
+                              'REPLAYABLE_KINDS = ("a", "c")'),
+        replay_src)
+    assert any("has no registered replayer" in p for p in missing)
+    # an undeclared replayer
+    extra = lint.replayer_problems(
+        decisions_src,
+        "_REPLAYERS = {\"a\": _f, \"z\": _g}\n")
+    assert any("not in REPLAYABLE_KINDS" in p for p in extra)
+    # a kind in both buckets
+    both = lint.replayer_problems(
+        decisions_src.replace('CONTEXT_KINDS = ("b",)',
+                              'CONTEXT_KINDS = ("a", "b")'),
+        replay_src)
+    assert any("BOTH" in p for p in both)
+
+
+def test_replayer_registry_refuses_non_literal_keys():
+    import pytest
+
+    with pytest.raises(AssertionError, match="non-literal"):
+        lint.code_replayer_kinds("_REPLAYERS = {KIND: _f}\n")

@@ -17,36 +17,103 @@ Fingerprints exclude line numbers (see :class:`~.model.Finding`), so
 edits above a grandfathered finding do not churn the baseline; the
 stored line is refreshed on every ``--update-baseline`` purely for
 human navigation.
+
+Every save stamps a **provenance header** (tool name + tool version +
+the HEAD short-sha at the moment the ratchet was burned): a stale
+entry failure names the commit its baseline was written at
+(:func:`provenance_note`), so triage starts from an anchor instead of
+``git log`` archaeology.  The header is shared by every ratcheted tool
+(ckcheck, ckprove, ckmodel) and rendered by each CLI's
+``--explain provenance``.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import subprocess
+import time
 
-__all__ = ["load_baseline", "save_baseline", "ratchet"]
+__all__ = [
+    "load_baseline",
+    "load_baseline_doc",
+    "save_baseline",
+    "ratchet",
+    "provenance_note",
+]
 
 SCHEMA = "ckcheck-baseline-v1"
+
+#: Bump when a tool's finding vocabulary/fingerprint rule changes in a
+#: way that invalidates old baselines (shared counter on purpose: the
+#: three ratchets ride one loader).
+TOOL_VERSION = 2
+
+
+def _head_sha(repo_root: str | None = None) -> str:
+    """HEAD's short sha, or ``"unknown"`` outside a usable git repo —
+    provenance must never fail a baseline write."""
+    root = repo_root or os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"], cwd=root,
+            capture_output=True, text=True, timeout=10)
+        sha = out.stdout.strip()
+        return sha if out.returncode == 0 and sha else "unknown"
+    except Exception:  # noqa: BLE001 - no git, no sha, no failure
+        return "unknown"
 
 
 def load_baseline(path: str) -> dict:
     """fingerprint → stored row.  A missing file is an empty baseline."""
+    return {row["fingerprint"]: row
+            for row in load_baseline_doc(path).get("findings", ())}
+
+
+def load_baseline_doc(path: str) -> dict:
+    """The whole baseline document (findings + provenance header).  A
+    missing file is an empty doc; a pre-provenance file (PRs 7-12)
+    loads with ``provenance`` absent."""
     if not os.path.exists(path):
         return {}
     with open(path) as f:
-        doc = json.load(f)
-    return {row["fingerprint"]: row for row in doc.get("findings", ())}
+        return json.load(f)
 
 
-def save_baseline(path: str, findings) -> None:
+def save_baseline(path: str, findings, tool: str = "ckcheck") -> None:
     rows = sorted(
         (f.to_row() for f in findings), key=lambda r: r["fingerprint"])
-    doc = {"schema": SCHEMA, "findings": rows}
+    doc = {
+        "schema": SCHEMA,
+        "provenance": {
+            "tool": tool,
+            "tool_version": TOOL_VERSION,
+            "head": _head_sha(),
+            "updated_at": time.strftime(
+                "%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        },
+        "findings": rows,
+    }
     tmp = path + ".tmp"
     with open(tmp, "w") as f:
         json.dump(doc, f, indent=1, sort_keys=True, allow_nan=False)
         f.write("\n")
     os.replace(tmp, path)
+
+
+def provenance_note(doc: dict) -> str:
+    """One human line anchoring a baseline in history — appended to
+    stale-entry failures so the triager knows which commit the ratchet
+    was burned at, and rendered by ``--explain provenance``."""
+    prov = (doc or {}).get("provenance")
+    if not prov:
+        return ("baseline carries no provenance header (written before "
+                "PR 13) — re-burn with --update-baseline to anchor it")
+    return (f"baseline burned by {prov.get('tool', '?')} "
+            f"v{prov.get('tool_version', '?')} at commit "
+            f"{prov.get('head', 'unknown')} "
+            f"({prov.get('updated_at', 'undated')})")
 
 
 def ratchet(findings, baseline: dict):

@@ -21,7 +21,13 @@ import json
 import os
 import sys
 
-from .baseline import load_baseline, ratchet, save_baseline
+from .baseline import (
+    load_baseline,
+    load_baseline_doc,
+    provenance_note,
+    ratchet,
+    save_baseline,
+)
 from .model import scan_package
 from .passes import AnalyzerConfig, run_passes
 
@@ -109,10 +115,13 @@ def _repo_extra_paths() -> list:
     for fn in sorted(os.listdir(tools_dir)):
         if fn.endswith(".py"):
             out.append(os.path.join(tools_dir, fn))
-    ck = os.path.join(tools_dir, "ckcheck")
-    for fn in sorted(os.listdir(ck)):
-        if fn.endswith(".py"):
-            out.append(os.path.join(ck, fn))
+    for sub in ("ckcheck", "ckmodel"):
+        ck = os.path.join(tools_dir, sub)
+        if not os.path.isdir(ck):
+            continue
+        for fn in sorted(os.listdir(ck)):
+            if fn.endswith(".py"):
+                out.append(os.path.join(ck, fn))
     return [p for p in out if os.path.isfile(p)]
 
 
@@ -219,6 +228,11 @@ def main(argv=None) -> int:
                          "baseline.json)")
     args = ap.parse_args(argv)
 
+    if args.explain == "provenance":
+        # derived solely from the baseline file — never pay the scan
+        print(provenance_note(load_baseline_doc(args.baseline)))
+        return 0
+
     findings, _pkg = analyze_repo(args.root)
     baseline = load_baseline(args.baseline)
     new, grand, stale = ratchet(findings, baseline)
@@ -246,7 +260,7 @@ def main(argv=None) -> int:
             for f in new:
                 print("  " + f.render())
             return 1
-        save_baseline(args.baseline, findings)
+        save_baseline(args.baseline, findings, tool="ckcheck")
         print(f"ckcheck: baseline rewritten: {len(findings)} finding(s) "
               f"({len(new)} added, {len(stale)} removed)")
         return 0
@@ -275,6 +289,8 @@ def main(argv=None) -> int:
         for row in stale:
             print(f"  [{row['fingerprint']}] {row.get('path')}:"
                   f"{row.get('line')} {row.get('message', '')[:80]}")
+        print("  (" + provenance_note(
+            load_baseline_doc(args.baseline)) + ")")
     if ok and not args.json:
         print(f"ckcheck: clean — {len(findings)} grandfathered finding(s) "
               f"remain in the baseline (ratchet: this number only goes "

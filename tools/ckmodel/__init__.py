@@ -1,0 +1,19 @@
+"""ckmodel — bounded exhaustive model checker for the pure controller
+state machines, plus the purity lint that keeps them checkable.
+
+The engine and the four machines live in
+``cekirdekler_tpu/analysis/model.py`` (they import the REAL controller
+functions — the same ones ``ckreplay verify`` re-executes, so there is
+no re-modeled transition relation to drift).  This package is the CLI
+face: the ratcheted CI gate (``python -m tools.ckmodel``), the
+machine/depth selectors, the ``--json`` report, ``--explain`` for one
+violation's counterexample, and the purity lint
+(:mod:`tools.ckmodel.purity`) asserting the model-checked functions
+stay pure by construction.
+
+Counterexamples are minimal decision-record traces: ``--save-trace``
+spills them as ``ck-decision-log-v1`` jsonl files that ``ckreplay
+verify`` and ``ckreplay explain`` consume directly.
+"""
+
+from .cli import main  # noqa: F401

@@ -1,0 +1,249 @@
+"""Purity lint for the model-checked controller functions.
+
+The bounded model checker (``cekirdekler_tpu/analysis/model.py``) and
+the replay verifier (``obs/replay.py``) both depend on one structural
+property: the controller transition functions are PURE — same inputs,
+same outputs, no clock, no randomness, no mutable module state.  That
+property is currently maintained by review; this pass makes it
+construction-checked.  For every declared pure function (and every
+same-module helper it reaches), the AST must contain:
+
+- **no time/randomness/environment calls** — anything rooted at
+  ``time`` / ``random`` / ``datetime`` / ``os`` / ``threading``, plus
+  the bare ``perf_counter``/``monotonic``/``time_ns`` forms and
+  ``open`` (a pure transition reads no file);
+- **no reads of mutable module globals** — a ``Name`` load must
+  resolve to a parameter/local, a builtin, an ``ALL_CAPS`` module
+  constant, another function/class defined in the same module, or a
+  **declared seam** (e.g. ``member_resplit`` delegating to
+  ``ClusterLoadBalancer`` — pure math living in another module).
+  The telemetry singletons (``DECISIONS``/``FLIGHT``/``REGISTRY``)
+  are exactly the reads this rule exists to keep OUT of the pure
+  cores: recording belongs to the stateful wrappers.
+
+Findings ride the shared ckcheck ratchet (expected-empty baseline) via
+the ckmodel CLI; the pass itself is pure ``ast`` over source text — no
+import of the linted modules, the lint_obs run-anywhere contract.
+"""
+
+from __future__ import annotations
+
+import ast
+import builtins
+import hashlib
+import os
+import re
+
+__all__ = ["PURE_FUNCTIONS", "PurityFinding", "scan_module", "run"]
+
+REPO = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+#: The declared pure surface: (module relpath, function names, seams).
+#: Seams are module-level names a pure function may read beyond the
+#: default rules — each one is a deliberate, documented dependency on
+#: other pure code (keep this list short; it is the purity contract's
+#: escape hatch, reviewed like a ckcheck suppression).
+PURE_FUNCTIONS = (
+    ("cekirdekler_tpu/obs/drain.py",
+     ("drain_transition", "apply_quarantine"), ()),
+    ("cekirdekler_tpu/serve/admission.py", ("admit_decision",), ()),
+    ("cekirdekler_tpu/serve/coalescer.py", ("plan_coalesce",), ()),
+    ("cekirdekler_tpu/obs/health.py", ("evaluate_window",), ()),
+    # member_resplit delegates to the cluster balancer's pure LCM math
+    # (one re-split implementation — the PR 12 rule)
+    ("cekirdekler_tpu/cluster/elastic.py", ("member_resplit",),
+     ("ClusterLoadBalancer",)),
+)
+
+#: Call roots that make a transition replay-inexact by construction.
+_FORBIDDEN_ROOTS = ("time", "random", "datetime", "os", "threading")
+_FORBIDDEN_BARE = ("perf_counter", "monotonic", "time_ns", "open",
+                   "getrandbits", "urandom")
+
+_CONST_RE = re.compile(r"^_?[A-Z][A-Z0-9_]*$")
+
+
+class PurityFinding:
+    """Duck-typed to the ckcheck ratchet (fingerprint/path/line/
+    to_row/render)."""
+
+    def __init__(self, path: str, func: str, rule: str, line: int,
+                 message: str):
+        self.path = path
+        self.func = func
+        self.rule = rule
+        self.line = int(line)
+        self.message = message
+        self.fingerprint = hashlib.sha1(
+            f"purity|{path}|{func}|{rule}|{message}".encode()
+        ).hexdigest()[:12]
+
+    def to_row(self) -> dict:
+        return {
+            "fingerprint": self.fingerprint, "path": self.path,
+            "line": self.line, "rule": f"purity-{self.rule}",
+            "func": self.func, "message": self.message,
+        }
+
+    def render(self) -> str:
+        return (f"[{self.fingerprint}] {self.path}:{self.line} "
+                f"purity-{self.rule} in {self.func}(): {self.message}")
+
+
+def _dotted_root(node: ast.AST) -> str | None:
+    """``time.monotonic`` → ``time``; ``a.b.c`` → ``a``."""
+    while isinstance(node, ast.Attribute):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+def _module_inventory(tree: ast.Module):
+    """(functions, classes, constants, other_globals) defined at module
+    level — the resolution environment for Name loads."""
+    funcs: dict[str, ast.AST] = {}
+    classes: set[str] = set()
+    constants: set[str] = set()
+    other: set[str] = set()
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            funcs[node.name] = node
+        elif isinstance(node, ast.ClassDef):
+            classes.add(node.name)
+        elif isinstance(node, (ast.Assign, ast.AnnAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for t in targets:
+                if isinstance(t, ast.Name):
+                    (constants if _CONST_RE.match(t.id)
+                     else other).add(t.id)
+        elif isinstance(node, (ast.Import, ast.ImportFrom)):
+            for alias in node.names:
+                other.add(alias.asname or alias.name.split(".")[0])
+    return funcs, classes, constants, other
+
+
+def _arg_names(args: ast.arguments) -> set[str]:
+    out = {a.arg for a in
+           (args.posonlyargs + args.args + args.kwonlyargs)}
+    if args.vararg:
+        out.add(args.vararg.arg)
+    if args.kwarg:
+        out.add(args.kwarg.arg)
+    return out
+
+
+def _local_names(fn: ast.AST) -> set[str]:
+    """Parameters + every Store-context name anywhere in the function
+    — including nested def/lambda names AND their parameters
+    (comprehension targets ride the Store walk).  Approximate scoping
+    is fine for a lint that only needs to rule OUT module globals."""
+    out = _arg_names(fn.args)
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Name) and isinstance(
+                node.ctx, (ast.Store, ast.Del)):
+            out.add(node.id)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node is not fn:
+            out.add(node.name)
+            out |= _arg_names(node.args)
+        elif isinstance(node, ast.Lambda):
+            out |= _arg_names(node.args)
+    return out
+
+
+def scan_module(source: str, relpath: str, func_names, seams
+                ) -> list[PurityFinding]:
+    """Purity findings for the declared functions of one module (and
+    the same-module helpers they reach, transitively)."""
+    tree = ast.parse(source)
+    funcs, classes, constants, _other = _module_inventory(tree)
+    seams = set(seams)
+    missing = [n for n in func_names if n not in funcs]
+    findings = [
+        PurityFinding(relpath, n, "missing", 0,
+                      f"declared pure function {n}() not found — the "
+                      "purity contract names a function that no longer "
+                      "exists")
+        for n in missing
+    ]
+    # transitive closure over same-module helper calls
+    queue = [n for n in func_names if n in funcs]
+    reached: set[str] = set()
+    while queue:
+        name = queue.pop()
+        if name in reached:
+            continue
+        reached.add(name)
+        for node in ast.walk(funcs[name]):
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Name) and \
+                    node.func.id in funcs:
+                queue.append(node.func.id)
+            # a helper passed as a value (sorted(key=_edf_key)) is
+            # reached too
+            elif isinstance(node, ast.Name) and \
+                    isinstance(node.ctx, ast.Load) and \
+                    node.id in funcs and node.id != name:
+                queue.append(node.id)
+
+    builtin_names = set(dir(builtins))
+    for name in sorted(reached):
+        fn = funcs[name]
+        local = _local_names(fn)
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                root = _dotted_root(node.func)
+                if root in _FORBIDDEN_ROOTS and root not in local:
+                    findings.append(PurityFinding(
+                        relpath, name, "impure-call", node.lineno,
+                        f"call rooted at module {root!r} — a pure "
+                        "transition may not read the clock, RNG, "
+                        "environment or locks"))
+                elif isinstance(node.func, ast.Name) and \
+                        node.func.id in _FORBIDDEN_BARE and \
+                        node.func.id not in local:
+                    findings.append(PurityFinding(
+                        relpath, name, "impure-call", node.lineno,
+                        f"call to {node.func.id}() — a pure transition "
+                        "may not read the clock, RNG or filesystem"))
+            elif isinstance(node, ast.Name) and \
+                    isinstance(node.ctx, ast.Load):
+                n = node.id
+                if (n in local or n in builtin_names or n in constants
+                        or n in reached or n in funcs or n in seams):
+                    continue
+                if n in classes:
+                    # same-module class: allowed only as a declared
+                    # seam — a transition constructing arbitrary
+                    # stateful objects is not obviously pure
+                    findings.append(PurityFinding(
+                        relpath, name, "impure-global", node.lineno,
+                        f"reads module class {n!r} without a declared "
+                        "seam"))
+                else:
+                    findings.append(PurityFinding(
+                        relpath, name, "impure-global", node.lineno,
+                        f"reads module global {n!r} — not a parameter, "
+                        "builtin, ALL_CAPS constant, same-module "
+                        "function, or declared seam"))
+    return findings
+
+
+def run(repo_root: str | None = None, table=None) -> list[PurityFinding]:
+    """The whole declared pure surface (the ckmodel CLI gate's purity
+    half).  ``table`` overrides :data:`PURE_FUNCTIONS` for fixtures."""
+    root = repo_root or REPO
+    out: list[PurityFinding] = []
+    for relpath, func_names, seams in (table or PURE_FUNCTIONS):
+        path = os.path.join(root, relpath)
+        if not os.path.isfile(path):
+            out.append(PurityFinding(
+                relpath, "*", "missing", 0,
+                f"declared pure module {relpath} not found"))
+            continue
+        with open(path) as f:
+            source = f.read()
+        out.extend(scan_module(source, relpath, func_names, seams))
+    out.sort(key=lambda f: (f.path, f.line, f.fingerprint))
+    return out

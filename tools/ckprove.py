@@ -58,6 +58,8 @@ if REPO not in sys.path:  # direct-script invocation
 
 from tools.ckcheck.baseline import (  # noqa: E402
     load_baseline,
+    load_baseline_doc,
+    provenance_note,
     ratchet,
     save_baseline,
 )
@@ -285,6 +287,11 @@ def main(argv=None) -> int:
                          "ckprove_baseline.json)")
     args = ap.parse_args(argv)
 
+    if args.explain == "provenance":
+        # derived solely from the baseline file — never pay the scan
+        print(provenance_note(load_baseline_doc(args.baseline)))
+        return 0
+
     findings, facts = analyze_corpus(args.root)
     baseline = load_baseline(args.baseline)
     new, grand, stale = ratchet(findings, baseline)
@@ -312,7 +319,7 @@ def main(argv=None) -> int:
             for f in new:
                 print("  " + f.render())
             return 1
-        save_baseline(args.baseline, findings)
+        save_baseline(args.baseline, findings, tool="ckprove")
         print(f"ckprove: baseline rewritten: {len(findings)} finding(s) "
               f"({len(new)} added, {len(stale)} removed)")
         return 0
@@ -343,6 +350,8 @@ def main(argv=None) -> int:
         for row in stale:
             print(f"  [{row['fingerprint']}] {row.get('path')}:"
                   f"{row.get('line')} {row.get('message', '')[:80]}")
+        print("  (" + provenance_note(
+            load_baseline_doc(args.baseline)) + ")")
     if ok:
         n_kernels = sum(1 for r in facts if "arrays" in r)
         print(f"ckprove: clean — {n_kernels} kernel(s) verified, "

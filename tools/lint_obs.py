@@ -30,6 +30,14 @@ the lint can run anywhere, including rigs where jax is broken):
   decision-provenance section, both directions (ISSUE 10;
   emitted-vs-declared is ``tools/ckcheck``'s invariant pass, same
   split as flight events).
+- **Replayer registry.**  Every ``REPLAYABLE_KINDS`` entry must have a
+  registered replayer in ``obs/replay.py``'s ``_REPLAYERS`` dict and
+  vice versa, and ``REPLAYABLE_KINDS ∪ CONTEXT_KINDS`` must equal
+  ``DECISION_KINDS`` exactly (ISSUE 14) — before this check, a new
+  decision kind left out of both buckets silently skipped ``ckreplay
+  verify``, indistinguishable from a deliberately context-only kind.
+  (The runtime assert in replay.py covers replayers↔REPLAYABLE only
+  when replay.py imports; this check runs where jax is broken too.)
 - **Debug endpoints.**  Every route the debug server serves
   (``obs/debugserver.py``'s routing dict, parsed by regex) must have a
   row in the doc's endpoint table, and every documented endpoint must
@@ -56,6 +64,7 @@ FLIGHT_PY = os.path.join(PKG, "obs", "flight.py")
 DEVICE_PY = os.path.join(PKG, "trace", "device.py")
 DECISIONS_PY = os.path.join(PKG, "obs", "decisions.py")
 DEBUGSERVER_PY = os.path.join(PKG, "obs", "debugserver.py")
+REPLAY_PY = os.path.join(PKG, "obs", "replay.py")
 
 #: Route-table pattern in obs/debugserver.py: `"/path": self._handler`.
 #: The index route "/" is navigation, not an endpoint contract row.
@@ -132,14 +141,18 @@ def doc_metric_names(doc_text: str) -> set[str]:
     return out
 
 
-def _tuple_var(path: str, varname: str) -> set[str]:
-    tree = ast.parse(open(path).read())
+def _tuple_var_src(source: str, varname: str, where: str) -> set[str]:
+    tree = ast.parse(source)
     for node in ast.walk(tree):
         if isinstance(node, ast.Assign):
             for t in node.targets:
                 if isinstance(t, ast.Name) and t.id == varname:
                     return set(ast.literal_eval(node.value))
-    raise AssertionError(f"{varname} tuple not found in {path}")
+    raise AssertionError(f"{varname} tuple not found in {where}")
+
+
+def _tuple_var(path: str, varname: str) -> set[str]:
+    return _tuple_var_src(open(path).read(), varname, path)
 
 
 def code_span_kinds() -> set[str]:
@@ -160,6 +173,89 @@ def code_device_kinds() -> set[str]:
 def code_decision_kinds() -> set[str]:
     """``DECISION_KINDS`` parsed out of obs/decisions.py."""
     return _tuple_var(DECISIONS_PY, "DECISION_KINDS")
+
+
+def code_replayable_kinds(source: str | None = None) -> set[str]:
+    """``REPLAYABLE_KINDS`` parsed out of obs/decisions.py."""
+    if source is None:
+        source = open(DECISIONS_PY).read()
+    return _tuple_var_src(source, "REPLAYABLE_KINDS", DECISIONS_PY)
+
+
+def code_context_kinds(source: str | None = None) -> set[str]:
+    """``CONTEXT_KINDS`` parsed out of obs/decisions.py."""
+    if source is None:
+        source = open(DECISIONS_PY).read()
+    return _tuple_var_src(source, "CONTEXT_KINDS", DECISIONS_PY)
+
+
+def code_replayer_kinds(source: str | None = None) -> set[str]:
+    """The keys of ``_REPLAYERS`` in obs/replay.py — every decision
+    kind with a registered replay function, parsed without importing
+    (the registry must be a dict literal with constant keys; this lint
+    exists to keep it that way)."""
+    if source is None:
+        source = open(REPLAY_PY).read()
+    tree = ast.parse(source)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == "_REPLAYERS" \
+                        and isinstance(node.value, ast.Dict):
+                    keys = set()
+                    for k in node.value.keys:
+                        if isinstance(k, ast.Constant) and \
+                                isinstance(k.value, str):
+                            keys.add(k.value)
+                        else:
+                            raise AssertionError(
+                                "_REPLAYERS has a non-literal key — "
+                                "the replayer registry must be "
+                                "statically checkable")
+                    return keys
+    raise AssertionError(
+        "_REPLAYERS dict literal not found in obs/replay.py")
+
+
+def replayer_problems(decisions_src: str | None = None,
+                      replay_src: str | None = None) -> list[str]:
+    """The replayer-registry drift findings (factored out so fixture
+    tests can feed broken sources — the other passes' discipline)."""
+    problems: list[str] = []
+    replayable = code_replayable_kinds(decisions_src)
+    context = code_context_kinds(decisions_src)
+    declared = code_decision_kinds() if decisions_src is None else \
+        _tuple_var_src(decisions_src, "DECISION_KINDS", "fixture")
+    replayers = code_replayer_kinds(replay_src)
+    for kind in sorted(replayable - replayers):
+        problems.append(
+            f"decision kind '{kind}' is declared REPLAYABLE but has no "
+            "registered replayer in obs/replay.py _REPLAYERS — ckreplay "
+            "verify would silently skip it"
+        )
+    for kind in sorted(replayers - replayable):
+        problems.append(
+            f"obs/replay.py registers a replayer for '{kind}' which is "
+            "not in REPLAYABLE_KINDS — an undeclared replayer is "
+            "invisible to the replay contract"
+        )
+    for kind in sorted(declared - replayable - context):
+        problems.append(
+            f"decision kind '{kind}' is in neither REPLAYABLE_KINDS "
+            "nor CONTEXT_KINDS — place it deliberately (a kind in "
+            "neither bucket silently skips verification)"
+        )
+    for kind in sorted((replayable | context) - declared):
+        problems.append(
+            f"decision kind '{kind}' is in REPLAYABLE_KINDS/"
+            "CONTEXT_KINDS but not declared in DECISION_KINDS"
+        )
+    for kind in sorted(replayable & context):
+        problems.append(
+            f"decision kind '{kind}' is in BOTH REPLAYABLE_KINDS and "
+            "CONTEXT_KINDS — the buckets partition DECISION_KINDS"
+        )
+    return problems
 
 
 def code_endpoints() -> set[str]:
@@ -298,6 +394,8 @@ def run() -> list[str]:
             "table but not in obs.decisions.DECISION_KINDS"
         )
 
+    problems.extend(replayer_problems())
+
     code_ep, doc_ep = code_endpoints(), doc_endpoints(doc_text)
     for ep in sorted(code_ep - doc_ep):
         problems.append(
@@ -325,6 +423,7 @@ def main(argv=None) -> int:
           f"{len(code_event_kinds())} flight event kinds, "
           f"{len(code_device_kinds())} device-track kinds, "
           f"{len(code_decision_kinds())} decision kinds, "
+          f"{len(code_replayer_kinds())} replayers, "
           f"{len(code_endpoints())} debug endpoints)")
     return 0
 

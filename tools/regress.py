@@ -35,9 +35,14 @@ drop 30% between rounds with no gate anywhere.  This tool is that gate:
   so a balancer edit that silently changes decisions becomes a named
   failure, not a perf mystery attributed to the hardware.
 
+  The same gate covers ``headline.model_ok`` (ISSUE 14): bench.py
+  also runs the bounded model checker (``tools/ckmodel``) over the
+  controller state machines, and an artifact whose controllers refute
+  a declared ``MODEL_INVARIANTS`` property hard-fails identically.
+
 Exit codes: 0 = healthy, 2 = headline regression, 3 = starved/null
-watched key OR replay-verify drift (both nonzero — CI gates on any
-nonzero).
+watched key OR replay-verify drift OR model-check drift (all nonzero
+— CI gates on any nonzero).
 
 Usage::
 
@@ -355,7 +360,23 @@ def diff_headlines(
                    "run `python -m tools.ckreplay verify` on the run's "
                    "CK_DECISION_LOG spill for the divergent seq")),
         })
-    hard = any(f["kind"] in ("starved", "replay-drift") for f in findings)
+    # model-check drift (ISSUE 14): model_ok is bench.py's in-process
+    # bounded exhaustive exploration of the controller machines
+    # against their declared MODEL_INVARIANTS.  False = a controller
+    # violates a machine-checked temporal invariant (flaps, starves,
+    # leaks share, diverges) — the same hard-failure class as replay
+    # drift (True and absent — pre-model artifacts — both pass).
+    if cand_h.get("model_ok") is False:
+        findings.append({
+            "kind": "model-drift", "key": "model_ok",
+            "reason": (
+                "the artifact's bounded model check refuted a declared "
+                "controller invariant; run `python -m tools.ckmodel` "
+                "for the violation and its minimal counterexample "
+                "trace (--explain <fp>, --save-trace)"),
+        })
+    hard = any(f["kind"] in ("starved", "replay-drift", "model-drift")
+               for f in findings)
     regressed = any(f["kind"] == "regression" for f in findings)
     code = 3 if hard else (2 if regressed else 0)
     return {
@@ -592,6 +613,8 @@ def main(argv=None) -> int:
                       f"{f['reason']}")
             elif f["kind"] == "replay-drift":
                 print(f"  REPLAY-DRIFT {f['key']}: {f['reason']}")
+            elif f["kind"] == "model-drift":
+                print(f"  MODEL-DRIFT {f['key']}: {f['reason']}")
             else:
                 print(f"  REGRESSION {f['key']}: {f['baseline']} -> "
                       f"{f['candidate']} (drop {f['drop_frac']:.1%} > "
