@@ -1278,6 +1278,19 @@ def main() -> None:
                 serving.get("coalesce_ratio")
                 if isinstance(serving, dict) else None
             ),
+            # serving resilience (ISSUE 15): the chaos sub-run's
+            # goodput-retained fraction and p99 — already
+            # exactness-gated to None inside loadgen_section when any
+            # chaos contract (no hangs, bit-exact, named failures,
+            # goodput floor) was violated
+            "serve_chaos_goodput_frac": (
+                serving.get("chaos_goodput_frac")
+                if isinstance(serving, dict) else None
+            ),
+            "serve_chaos_p99_ms": (
+                serving.get("chaos_p99_ms")
+                if isinstance(serving, dict) else None
+            ),
             # the recovery tier's keys (ISSUE 13): wall from injected
             # degradation to the drain taking effect, and post-resume
             # windows for a kill-rejoin run's split to settle — both

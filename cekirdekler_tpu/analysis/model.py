@@ -70,6 +70,9 @@ __all__ = [
     "AdmissionMachine",
     "CoalesceMachine",
     "BalanceMachine",
+    "BreakerMachine",
+    "ShedMachine",
+    "RetryMachine",
     "MACHINE_NAMES",
     "build_machines",
     "check_machine",
@@ -79,8 +82,10 @@ __all__ = [
 ]
 
 #: CLI/bench machine vocabulary: ``serve`` groups the admission and
-#: coalesce sub-machines (one serving tier, two pure planners).
-MACHINE_NAMES = ("drain", "elastic", "serve", "balance")
+#: coalesce sub-machines (one serving tier, two pure planners);
+#: ``resilience`` groups the breaker, brownout-shed and retry-budget
+#: machines (``serve/resilience.py``).
+MACHINE_NAMES = ("drain", "elastic", "serve", "balance", "resilience")
 
 #: Deepen-on-the-bench-rig knob: a positive integer scales the bounds
 #: (balancer horizon, starvation caps, rate alphabet) beyond tier-1.
@@ -685,10 +690,11 @@ class ElasticMachine(MachineBase):
 # ---------------------------------------------------------------------------
 
 class AdmissionMachine(MachineBase):
-    """Product of per-tenant in-flight counts × queue depth × health
-    flips, driving :func:`~..serve.admission.admit_decision` at every
-    submit with the frontend's own accounting (admit → in-flight+1 and
-    queue+1; dispatch → queue−1; complete → in-flight−1)."""
+    """Product of per-tenant in-flight counts × queue depth × health /
+    breaker / brownout flips, driving
+    :func:`~..serve.admission.admit_decision` at every submit with the
+    frontend's own accounting (admit → in-flight+1 and queue+1;
+    dispatch → queue−1; complete → in-flight−1)."""
 
     name = "serve/admission"
     checks = ("quota-exact", "queue-bounded", "reject-order",
@@ -705,27 +711,32 @@ class AdmissionMachine(MachineBase):
         self.A = A
         self.tenants = tuple(tenants)
         self.quota = int(quota)
+        self.shed_quota = A.brownout_share(quota)
         self.max_queue_depth = int(max_queue_depth)
         self.decide = decide or A.admit_decision
 
     def initial_states(self):
-        return [(tuple(0 for _ in self.tenants), 0, True)]
+        return [(tuple(0 for _ in self.tenants), 0, True, False, False)]
 
     def state_doc(self, state):
-        inflight, queue, healthy = state
+        inflight, queue, healthy, breaker, brownout = state
         return {
             "inflight": {t: n for t, n in zip(self.tenants, inflight)},
             "queue_depth": queue,
             "healthy": healthy,
+            "breaker_open": breaker,
+            "brownout": brownout,
         }
 
     def _submit(self, state, ti: int, est: float, unsafe: bool):
-        inflight, queue, healthy = state
+        inflight, queue, healthy, breaker, brownout = state
         dec = self.decide(
             tenant_inflight=inflight[ti], quota=self.quota,
             queue_depth=queue, max_queue_depth=self.max_queue_depth,
             healthy=healthy, est_batch_s=est, kernel_unsafe=unsafe,
-            kernel_finding="scatter-write" if unsafe else None)
+            kernel_finding="scatter-write" if unsafe else None,
+            breaker_open=breaker, breaker_retry_after_s=0.25,
+            brownout=brownout, shed_quota=self.shed_quota, priority=1)
         row = {"kind": "admission", "inputs": {
             "tenant": self.tenants[ti],
             "tenant_inflight": inflight[ti],
@@ -736,15 +747,20 @@ class AdmissionMachine(MachineBase):
             "est_batch_s": est,
             "kernel_unsafe": unsafe,
             "kernel_finding": "scatter-write" if unsafe else None,
+            "breaker_open": breaker,
+            "breaker_retry_after_s": 0.25,
+            "brownout": brownout,
+            "shed_quota": self.shed_quota,
+            "priority": 1,
         }, "outputs": dict(dec)}
         if dec.get("admit"):
             inflight = tuple(
                 n + 1 if i == ti else n for i, n in enumerate(inflight))
             queue += 1
-        return dec, row, (inflight, queue, healthy)
+        return dec, row, (inflight, queue, healthy, breaker, brownout)
 
     def actions(self, state):
-        inflight, queue, healthy = state
+        inflight, queue, healthy, breaker, brownout = state
         out = []
         for ti in range(len(self.tenants)):
             for est in self.EST_BATCH:
@@ -755,18 +771,25 @@ class AdmissionMachine(MachineBase):
         dec, row, nxt = self._submit(state, 0, 0.1, True)
         out.append(("submit(a,unsafe)", [row], nxt))
         if queue > 0:
-            out.append(("dispatch", [], (inflight, queue - 1, healthy)))
+            out.append(("dispatch", [],
+                        (inflight, queue - 1, healthy, breaker,
+                         brownout)))
         for ti, n in enumerate(inflight):
             if n > 0:
                 nf = tuple(v - 1 if i == ti else v
                            for i, v in enumerate(inflight))
                 out.append((f"complete({self.tenants[ti]})", [],
-                            (nf, queue, healthy)))
-        out.append(("health-flip", [], (inflight, queue, not healthy)))
+                            (nf, queue, healthy, breaker, brownout)))
+        out.append(("health-flip", [],
+                    (inflight, queue, not healthy, breaker, brownout)))
+        out.append(("breaker-flip", [],
+                    (inflight, queue, healthy, not breaker, brownout)))
+        out.append(("brownout-flip", [],
+                    (inflight, queue, healthy, breaker, not brownout)))
         return out
 
     def check_state(self, state):
-        inflight, queue, _healthy = state
+        inflight, queue, _healthy, _breaker, _brownout = state
         bad = []
         self._hit("quota-exact")
         for t, n in zip(self.tenants, inflight):
@@ -791,10 +814,14 @@ class AdmissionMachine(MachineBase):
         unsafe, healthy = inp["kernel_unsafe"], inp["healthy"]
         queue_full = inp["queue_depth"] >= inp["max_queue_depth"]
         over_quota = inp["tenant_inflight"] >= inp["quota"]
+        shed = (inp["brownout"]
+                and inp["tenant_inflight"] >= inp["shed_quota"])
         expected = (
             self.A.REJECT_KERNEL if unsafe else
             self.A.REJECT_HEALTH if not healthy else
+            self.A.REJECT_BREAKER if inp["breaker_open"] else
             self.A.REJECT_QUEUE if queue_full else
+            self.A.REJECT_BROWNOUT if shed else
             self.A.REJECT_QUOTA if over_quota else None)
         self._hit("admit-iff")
         if out.get("admit") != (expected is None):
@@ -1216,6 +1243,460 @@ class BalanceMachine(MachineBase):
 
 
 # ---------------------------------------------------------------------------
+# resilience: breaker × shed × retry (serve/resilience.py)
+# ---------------------------------------------------------------------------
+
+class BreakerMachine(MachineBase):
+    """Every outcome/admit/tick interleaving of the circuit breaker
+    (:func:`~..serve.resilience.breaker_transition` ×
+    :func:`~..serve.resilience.breaker_admit`) over integer ticks
+    (``now`` is an input to the pure functions, so the model clock is
+    exact).  The model carries its own GROUND-TRUTH consecutive-failure
+    counter, independent of the implementation's ``failures`` field —
+    a broken transition cannot hide its own evidence."""
+
+    name = "resilience/breaker"
+    checks = ("breaker-half-open-one-probe", "breaker-opens-on-threshold",
+              "breaker-honest-hint", "breaker-open-times-out",
+              "breaker-recovers-on-ok")
+
+    def __init__(self, threshold: int = 2, open_ticks: int = 3,
+                 transition=None, admit=None):
+        from ..serve import resilience as R
+
+        self.invariants = R.BREAKER_INVARIANTS
+        super().__init__()
+        self.R = R
+        self.threshold = int(threshold)
+        self.open_ticks = int(open_ticks)
+        self.transition = transition or R.breaker_transition
+        self.admit = admit or R.breaker_admit
+
+    def initial_states(self):
+        # (real breaker state dict as a tuple, tick, ground consecutive
+        # failures) — canon replaces the absolute clock with the age
+        return [(self._freeze(self.R.breaker_init()), 0, 0)]
+
+    @staticmethod
+    def _freeze(st: dict) -> tuple:
+        return (st["state"], int(st["failures"]),
+                bool(st["probe_inflight"]),
+                None if st["opened_t"] is None else float(st["opened_t"]))
+
+    @staticmethod
+    def _thaw(frozen: tuple) -> dict:
+        return {"state": frozen[0], "failures": frozen[1],
+                "probe_inflight": frozen[2], "opened_t": frozen[3]}
+
+    def canon(self, state):
+        frozen, tick, ground = state
+        st, fails, probe, opened_t = frozen
+        age = None
+        if opened_t is not None:
+            age = min(int(tick - opened_t), self.open_ticks + 1)
+        return (st, min(fails, self.threshold), probe, age,
+                min(ground, self.threshold))
+
+    def state_doc(self, state):
+        frozen, tick, ground = state
+        return {"breaker": self._thaw(frozen), "tick": tick,
+                "ground_consecutive_failures": ground,
+                "threshold": self.threshold,
+                "open_ticks": self.open_ticks}
+
+    def _row(self, op: str, st: dict, out: dict, now: float,
+             event: str | None = None) -> dict:
+        inputs = {"key": "model", "state": dict(st), "now": float(now),
+                  "threshold": self.threshold,
+                  "open_s": float(self.open_ticks), "op": op}
+        if event is not None:
+            inputs["event"] = event
+        outputs = {"state": dict(out["state"]),
+                   "action": out.get("action")}
+        if op == "admit":
+            outputs.update({"allow": out["allow"], "probe": out["probe"],
+                            "retry_after_s": out["retry_after_s"]})
+        return {"kind": "breaker", "inputs": inputs, "outputs": outputs}
+
+    def actions(self, state):
+        frozen, tick, ground = state
+        st = self._thaw(frozen)
+        out = []
+        for event in ("success", "failure"):
+            res = self.transition(st, event, float(tick), self.threshold,
+                                  float(self.open_ticks))
+            if st["state"] == self.R.BREAKER_CLOSED:
+                g2 = 0 if event == "success" else ground + 1
+            elif st["state"] == self.R.BREAKER_HALF_OPEN:
+                g2 = 0 if event == "success" else self.threshold
+            else:
+                g2 = ground  # stale outcome against an open breaker
+            out.append((
+                f"outcome-{event}",
+                [self._row("transition", st, res, tick, event)],
+                (self._freeze(res["state"]), tick + 1, g2)))
+        adm = self.admit(st, float(tick), float(self.open_ticks))
+        out.append((
+            "admit",
+            [self._row("admit", st, adm, tick)],
+            (self._freeze(adm["state"]), tick + 1, ground)))
+        return out
+
+    def check_action(self, state, label, rows, nxt):
+        frozen, tick, ground = state
+        st = self._thaw(frozen)
+        inp, out = rows[0]["inputs"], rows[0]["outputs"]
+        bad = []
+        if inp["op"] == "admit":
+            self._hit("breaker-half-open-one-probe")
+            if st["state"] == self.R.BREAKER_HALF_OPEN \
+                    and st["probe_inflight"] and out["allow"]:
+                bad.append((
+                    "breaker-half-open-one-probe",
+                    "half-open admitted a SECOND probe while one was "
+                    "in flight"))
+            self._hit("breaker-honest-hint")
+            if not out["allow"]:
+                hint = out["retry_after_s"]
+                remaining = None
+                if st["state"] == self.R.BREAKER_OPEN \
+                        and st["opened_t"] is not None:
+                    remaining = (float(self.open_ticks)
+                                 - (tick - st["opened_t"]))
+                if hint is None or hint <= 0.0 \
+                        or hint > float(self.open_ticks):
+                    bad.append((
+                        "breaker-honest-hint",
+                        f"refused admit carries hint {hint!r}, outside "
+                        f"(0, open_s={self.open_ticks}]"))
+                elif remaining is not None and remaining > 0.005 \
+                        and abs(hint - remaining) > 1e-9:
+                    bad.append((
+                        "breaker-honest-hint",
+                        f"open breaker hinted {hint}, the remaining "
+                        f"window is {remaining}"))
+        else:
+            self._hit("breaker-opens-on-threshold")
+            opened = out["action"] == "opened"
+            if st["state"] == self.R.BREAKER_CLOSED:
+                consec = (ground + 1 if inp["event"] == "failure" else 0)
+                if opened and consec < self.threshold:
+                    bad.append((
+                        "breaker-opens-on-threshold",
+                        f"opened after only {consec} consecutive "
+                        f"failure(s) (threshold {self.threshold})"))
+                if not opened and consec >= self.threshold:
+                    bad.append((
+                        "breaker-opens-on-threshold",
+                        f"{consec} consecutive failures reached the "
+                        f"threshold ({self.threshold}) but the breaker "
+                        "stayed closed"))
+        return bad
+
+    def check_liveness(self, state):
+        frozen, tick, ground = state
+        st = self._thaw(frozen)
+        bad = []
+        if st["state"] == self.R.BREAKER_OPEN:
+            # open-times-out: keep admitting; within open_ticks + 1
+            # admits one must be granted as the probe
+            self._hit("breaker-open-times-out")
+            cur, t = dict(st), float(tick)
+            extra, granted = [], False
+            for _ in range(self.open_ticks + 1):
+                adm = self.admit(cur, t, float(self.open_ticks))
+                extra.append(self._row("admit", cur, adm, t))
+                cur, t = dict(adm["state"]), t + 1
+                if adm["allow"]:
+                    granted = adm["probe"]
+                    break
+            if not granted:
+                bad.append((
+                    "breaker-open-times-out",
+                    f"open breaker granted no probe within "
+                    f"{self.open_ticks + 1} admits of opening", extra))
+        if st["state"] != self.R.BREAKER_CLOSED:
+            # recovers-on-ok, at EXACTLY the declared bound (slack here
+            # would let a one-extra-step regression slip past the
+            # MODEL_INVARIANTS statement — the drain-machine rule): an
+            # all-success schedule delivers the in-flight probe's
+            # success when one exists, else admits; worst reachable
+            # chain = open_ticks denied admits + the probe admit + its
+            # success = open_ticks + 2 steps, exactly the bound.
+            self._hit("breaker-recovers-on-ok")
+            bound = self.open_ticks + 2
+            cur, t = dict(st), float(tick)
+            extra = []
+            for _ in range(bound):
+                if cur["state"] == self.R.BREAKER_CLOSED:
+                    break
+                if cur["state"] == self.R.BREAKER_HALF_OPEN \
+                        and cur["probe_inflight"]:
+                    res = self.transition(
+                        cur, "success", t, self.threshold,
+                        float(self.open_ticks))
+                    extra.append(self._row(
+                        "transition", cur, res, t, "success"))
+                    cur, t = dict(res["state"]), t + 1
+                    continue
+                adm = self.admit(cur, t, float(self.open_ticks))
+                extra.append(self._row("admit", cur, adm, t))
+                cur, t = dict(adm["state"]), t + 1
+            if cur["state"] != self.R.BREAKER_CLOSED:
+                bad.append((
+                    "breaker-recovers-on-ok",
+                    f"breaker still {cur['state']} after {bound} "
+                    "all-success steps (the declared bound: open_s + "
+                    "2; permanent open under all-ok inputs)", extra))
+        return bad
+
+
+class ShedMachine(MachineBase):
+    """Every pressure-signal sequence through
+    :func:`~..serve.resilience.brownout_transition` (queue depth ×
+    open breakers × drained lanes per evaluation), plus the
+    ``admit_decision`` brownout gate at every active state over
+    in-flight × priority — the starvation floor is checked where the
+    shed actually happens."""
+
+    name = "resilience/shed"
+    checks = ("shed-pressure-gated", "shed-quota-floor",
+              "shed-named-hint", "shed-releases")
+
+    QUEUE_LEVELS = (0, 2, 4)  # clear, at clear-mark, at watermark
+    WATERMARK = 4
+    CLEAR_MARK = 2
+
+    def __init__(self, engage_streak: int = 2, quota: int = 2,
+                 transition=None, decide=None):
+        from ..serve import admission as A
+        from ..serve import resilience as R
+
+        self.invariants = R.SHED_INVARIANTS
+        super().__init__()
+        self.R, self.A = R, A
+        self.engage_streak = int(engage_streak)
+        self.quota = int(quota)
+        self.transition = transition or R.brownout_transition
+        self.decide = decide or A.admit_decision
+
+    def initial_states(self):
+        return [(False, 0)]
+
+    def canon(self, state):
+        active, streak = state
+        return (bool(active), min(int(streak), self.engage_streak))
+
+    def state_doc(self, state):
+        return {"active": state[0], "streak": state[1],
+                "engage_streak": self.engage_streak}
+
+    def actions(self, state):
+        active, streak = state
+        out = []
+        for qd in self.QUEUE_LEVELS:
+            for ob in (0, 1):
+                for dl in (0, 1):
+                    res = self.transition(
+                        {"active": active, "streak": streak}, qd,
+                        self.WATERMARK, self.CLEAR_MARK, ob, dl,
+                        engage_streak=self.engage_streak)
+                    row = {"kind": "shed", "inputs": {
+                        "state": {"active": active, "streak": streak},
+                        "queue_depth": qd,
+                        "watermark": self.WATERMARK,
+                        "clear_mark": self.CLEAR_MARK,
+                        "open_breakers": ob, "drained_lanes": dl,
+                        "engage_streak": self.engage_streak,
+                    }, "outputs": dict(res)}
+                    out.append((
+                        f"eval(qd={qd},ob={ob},dl={dl})", [row],
+                        (bool(res["active"]), int(res["streak"]))))
+        return out
+
+    def check_action(self, state, label, rows, nxt):
+        active, streak = state
+        inp, out = rows[0]["inputs"], rows[0]["outputs"]
+        bad = []
+        self._hit("shed-pressure-gated")
+        pressured = bool(
+            inp["queue_depth"] >= inp["watermark"]
+            or ((inp["open_breakers"] > 0 or inp["drained_lanes"] > 0)
+                and inp["queue_depth"] >= inp["clear_mark"]))
+        if out["changed"] and out["active"]:
+            if not pressured or streak < self.engage_streak - 1:
+                bad.append((
+                    "shed-pressure-gated",
+                    f"brownout engaged at streak {streak} under "
+                    f"{'un' if not pressured else ''}pressured inputs "
+                    f"({label}) — the {self.engage_streak}-evaluation "
+                    "hysteresis was skipped"))
+        return bad
+
+    def check_state(self, state):
+        active, streak = state
+        bad = []
+        if not active:
+            return bad
+        # the shed gate itself, at every active state: in-flight ×
+        # priority over a clear-other-gates admit
+        self._hit("shed-quota-floor")
+        self._hit("shed-named-hint")
+        shed_quota = self.A.brownout_share(self.quota)
+        for inflight in (0, 1, self.quota):
+            for priority in (0, 1):
+                dec = self.decide(
+                    tenant_inflight=inflight, quota=self.quota,
+                    queue_depth=0, max_queue_depth=64, healthy=True,
+                    est_batch_s=0.01, brownout=True,
+                    shed_quota=shed_quota, priority=priority)
+                if inflight == 0 and not dec["admit"]:
+                    bad.append((
+                        "shed-quota-floor",
+                        f"brownout shed a tenant with ZERO requests in "
+                        f"flight (priority {priority}) — the "
+                        "starvation floor is broken"))
+                if not dec["admit"]:
+                    if dec["reason"] != self.A.REJECT_BROWNOUT:
+                        bad.append((
+                            "shed-named-hint",
+                            f"brownout rejection named {dec['reason']!r}"
+                            f", expected {self.A.REJECT_BROWNOUT!r}"))
+                    if (dec["retry_after_s"] or 0.0) < 0.005:
+                        bad.append((
+                            "shed-named-hint",
+                            f"brownout rejection hint "
+                            f"{dec['retry_after_s']!r} is below the "
+                            "anti-busy-loop floor"))
+        return bad
+
+    def check_liveness(self, state):
+        active, streak = state
+        if not active:
+            return []
+        self._hit("shed-releases")
+        cur = {"active": True, "streak": int(streak)}
+        extra = []
+        for _ in range(self.engage_streak):
+            res = self.transition(
+                cur, 0, self.WATERMARK, self.CLEAR_MARK, 0, 0,
+                engage_streak=self.engage_streak)
+            extra.append({"kind": "shed", "inputs": {
+                "state": dict(cur), "queue_depth": 0,
+                "watermark": self.WATERMARK,
+                "clear_mark": self.CLEAR_MARK,
+                "open_breakers": 0, "drained_lanes": 0,
+                "engage_streak": self.engage_streak,
+            }, "outputs": dict(res)})
+            cur = {"active": res["active"], "streak": res["streak"]}
+            if not cur["active"]:
+                return []
+        return [(
+            "shed-releases",
+            f"brownout still active after {self.engage_streak} "
+            "all-clear evaluations (sticky degraded mode)", extra)]
+
+
+class RetryMachine(MachineBase):
+    """Every (attempt × budget × deadline × jitter) point of
+    :func:`~..serve.resilience.retry_decision`, with the budget's
+    spend/refill accounting driven alongside — proves retries can
+    never outrun the budget or the backoff bounds."""
+
+    name = "resilience/retry"
+    checks = ("retry-budget-bounded", "retry-backoff-bounded")
+
+    JITTER = (0.0, 0.999)
+    DEADLINES = (None, 0.001, 10.0)
+    BASE_S = 0.01
+    CAP_S = 0.04
+
+    def __init__(self, max_attempts: int = 2, budget_cap: int = 2,
+                 decide=None):
+        from ..serve import resilience as R
+
+        self.invariants = R.RETRY_INVARIANTS
+        super().__init__()
+        self.R = R
+        self.max_attempts = int(max_attempts)
+        self.budget_cap = int(budget_cap)
+        self.decide = decide or R.retry_decision
+
+    def initial_states(self):
+        return [(0, self.budget_cap)]
+
+    def state_doc(self, state):
+        return {"attempt": state[0], "tokens": state[1],
+                "max_attempts": self.max_attempts}
+
+    def actions(self, state):
+        attempt, tokens = state
+        out = []
+        for u in self.JITTER:
+            for dl in self.DEADLINES:
+                rd = self.decide(attempt, self.max_attempts,
+                                 float(tokens), dl, self.BASE_S,
+                                 self.CAP_S, u)
+                row = {"kind": "retry", "inputs": {
+                    "attempt": attempt,
+                    "max_attempts": self.max_attempts,
+                    "tokens": float(tokens),
+                    "deadline_left_s": dl,
+                    "base_s": self.BASE_S, "cap_s": self.CAP_S,
+                    "jitter_u": u,
+                }, "outputs": dict(rd)}
+                nxt = ((min(attempt + 1, self.max_attempts + 1),
+                        max(0, tokens - 1))
+                       if rd["retry"] else (attempt, tokens))
+                out.append((f"retry?(u={u},dl={dl})", [row], nxt))
+        out.append(("refill", [],
+                    (attempt, min(self.budget_cap, tokens + 1))))
+        if attempt > 0:
+            out.append(("fresh-request", [], (0, tokens)))
+        return out
+
+    def check_action(self, state, label, rows, nxt):
+        if not rows:
+            return []
+        attempt, tokens = state
+        inp, out = rows[0]["inputs"], rows[0]["outputs"]
+        bad = []
+        self._hit("retry-budget-bounded")
+        if out["retry"]:
+            if inp["tokens"] < 1.0:
+                bad.append((
+                    "retry-budget-bounded",
+                    f"retry granted with {inp['tokens']} budget "
+                    "tokens — the budget cannot bound a storm"))
+            if inp["attempt"] >= inp["max_attempts"]:
+                bad.append((
+                    "retry-budget-bounded",
+                    f"retry granted at attempt {inp['attempt']} with "
+                    f"max_attempts {inp['max_attempts']}"))
+        elif out.get("reason") not in (
+                "attempts-exhausted", "budget-exhausted", "deadline"):
+            bad.append((
+                "retry-budget-bounded",
+                f"refused retry names no reason ({out.get('reason')!r})"))
+        self._hit("retry-backoff-bounded")
+        if out["retry"]:
+            delay = out["delay_s"]
+            if delay is None or delay < 0.0 \
+                    or delay > 1.5 * inp["cap_s"] + 1e-12:
+                bad.append((
+                    "retry-backoff-bounded",
+                    f"granted delay {delay!r} outside "
+                    f"[0, 1.5*cap={1.5 * inp['cap_s']}]"))
+            dl = inp["deadline_left_s"]
+            if dl is not None and delay is not None and delay >= dl:
+                bad.append((
+                    "retry-backoff-bounded",
+                    f"granted delay {delay} overshoots the remaining "
+                    f"deadline {dl}"))
+        return bad
+
+
+# ---------------------------------------------------------------------------
 # assembly, reports, and the counterexample bridge
 # ---------------------------------------------------------------------------
 
@@ -1267,6 +1748,16 @@ def build_machines(name: str, quick: bool = False,
             (1.0, 1.5, 2.0, 3.0, 5.0, 8.0)
         return [BalanceMachine(rate_alphabet=rates,
                                horizon=32 * scale)]
+    if name == "resilience":
+        if quick:
+            return [BreakerMachine(threshold=2, open_ticks=2),
+                    ShedMachine(engage_streak=1),
+                    RetryMachine(max_attempts=1, budget_cap=1)]
+        return [BreakerMachine(threshold=2 + (scale - 1),
+                               open_ticks=2 + scale),
+                ShedMachine(engage_streak=1 + scale),
+                RetryMachine(max_attempts=1 + scale,
+                             budget_cap=1 + scale)]
     raise ValueError(
         f"unknown machine {name!r}; machines: {MACHINE_NAMES}")
 

@@ -36,7 +36,12 @@ from typing import Any, Sequence
 
 from ..analysis import flag_row
 from ..arrays.clarray import ClArray
-from ..errors import ComputeValidationError, KernelVerifyError
+from ..errors import (
+    ComputeValidationError,
+    FusedBatchError,
+    InjectedFaultError,
+    KernelVerifyError,
+)
 from ..hardware import Devices
 from ..kernel.registry import KernelProgram
 from ..metrics.registry import REGISTRY
@@ -225,6 +230,12 @@ class Cores:
         # ping-ponging A,B,A,B) pays one tuple compare per call instead
         # of an engage/break(close+drain) cycle per call
         self._fused_candidate: tuple | None = None
+        # True while compute_fused_batch runs a per-call iteration it
+        # already lane-preflighted: stream-driver submits inside the
+        # iteration skip their own fault fire (a mid-phase fire would
+        # be a dirty cross-lane failure containment cannot repair).
+        # Single-writer by the enqueue single-driver contract.
+        self._batch_preflighted = False
         self._fused_pending = 0
         # serializes [grab pending + submit to drivers] so a close/drain
         # cannot slip between a concurrent flush's grab and its submits
@@ -970,6 +981,27 @@ class Cores:
         overlaps device A's execution; FIFO per device)."""
         _tt = TRACER.t0()
         try:
+            # PREFLIGHT every lane before queuing ANY lane's closure:
+            # pending driver errors and the armed driver-submit fault
+            # point raise here, where no device has been handed this
+            # batch yet — a refusal is then CLEAN (no diverged iteration
+            # counts) and the serving tier's containment can re-dispatch
+            # the residue bit-exactly.  One counted fault hit per lane
+            # either way (submit skips its own fire when preflighted).
+            for w, _off, _size in run.rows:
+                w.dispatch_preflight()
+        except Exception:
+            # the worker preflight stamps _ck_clean_window per raise
+            # source: True for the injected fault (fired before any
+            # closure queued), False for a popped pending error (an
+            # EARLIER closure's work never applied — re-dispatch could
+            # silently corrupt)
+            with self._lock:
+                self._fused_sig = None
+                self._fused_run = None
+                self._fused_candidate = None
+            raise
+        try:
             for w, off, size in run.rows:
                 def dispatch(w=w, off=off, size=size, run=run, iters=iters):
                     with w.lock:
@@ -984,14 +1016,16 @@ class Cores:
                         finally:
                             w.end_bench(run.compute_id)
 
-                w.dispatch_async(dispatch, depth=self.fused_queue_depth)
+                w.dispatch_async(dispatch, depth=self.fused_queue_depth,
+                                 preflighted=True)
         except Exception:
-            # a submit failure (a driver re-raising an earlier error)
-            # after some rows were queued leaves devices with DIVERGED
-            # iteration counts for this batch — poison the window so a
-            # caller that catches the error cannot keep deferring into
-            # it (the next call goes per-call; the cruncher's error gate
-            # additionally refuses further work until reset)
+            # a submit failure (a driver re-raising an error a closure
+            # hit since the preflight) after some rows were queued
+            # leaves devices with DIVERGED iteration counts for this
+            # batch — poison the window so a caller that catches the
+            # error cannot keep deferring into it (the next call goes
+            # per-call; the cruncher's error gate additionally refuses
+            # further work until reset)
             with self._lock:
                 self._fused_sig = None
                 self._fused_run = None
@@ -1135,7 +1169,15 @@ class Cores:
         Returns ``{"iters", "fused", "ladder_iters", "per_call_iters"}``
         — observability for the coalesce-ratio accounting (the ladder
         iterations also count into ``fused_stats`` / ``ck_fused_*``
-        like any fused window)."""
+        like any fused window).
+
+        A dispatch failure raises :class:`~..errors.FusedBatchError`
+        carrying the NAMED cause, how many iterations applied before the
+        failure, and whether the failed residue is ``clean``
+        (preflight-refused before any lane's closure was queued — see
+        ``_dispatch_fused`` — so re-dispatching it is bit-exact).  The
+        serving tier's blast-radius containment
+        (``serve/resilience.py``) is the consumer."""
         iters = int(iters)
         if iters < 1:
             raise ComputeValidationError(
@@ -1150,18 +1192,55 @@ class Cores:
         )
         done = 0
         ladder = 0
-        while done < iters:
-            t_start = time.perf_counter()
-            if self._batch_defer(sig, iters - done, t_start):
-                ladder = iters - done
-                done = iters
-                break
-            self.compute(
-                kernel_names, params, compute_id, global_range,
-                local_range, global_offset=global_offset,
-                value_args=value_args,
-            )
-            done += 1
+        try:
+            while done < iters:
+                t_start = time.perf_counter()
+                if self._batch_defer(sig, iters - done, t_start):
+                    ladder = iters - done
+                    done = iters
+                    break
+                # lane preflight BEFORE the per-call dispatch: an armed
+                # driver-submit clause (fused or stream queue) fires
+                # here, while nothing of this iteration has reached any
+                # lane — a CLEAN failure containment can re-dispatch.
+                # The iteration's own stream submits then skip their
+                # fire (_batch_preflighted): a mid-phase fire after
+                # some lanes launched would be dirty by construction.
+                if FAULTS.enabled:
+                    # the worker preflight stamps _ck_clean_window per
+                    # raise source (fault = clean, popped prior error
+                    # = NOT clean — see _DriverQueue.preflight)
+                    for w in self.workers:
+                        w.stream_preflight()
+                self._batch_preflighted = True
+                try:
+                    self.compute(
+                        kernel_names, params, compute_id, global_range,
+                        local_range, global_offset=global_offset,
+                        value_args=value_args,
+                    )
+                finally:
+                    self._batch_preflighted = False
+                done += 1
+        except Exception as e:
+            # surface the per-window failure cause as STRUCTURE, not one
+            # opaque sync-point exception (the serving tier's blast-
+            # radius containment input, serve/resilience.py):
+            # applied_iters = iterations that completed dispatch before
+            # the failure, clean = the failed residue was never queued
+            # to any lane (the dispatch preflight raised — see
+            # _dispatch_fused), so re-dispatching it is bit-exact.  A
+            # per-call iteration failing, or a submit-loop failure after
+            # the preflight, is NOT clean: lanes may have diverged.
+            if isinstance(e, InjectedFaultError):
+                cause = f"injected:{e.point}"
+            else:
+                cause = type(e).__name__
+            raise FusedBatchError(
+                cause=cause, applied_iters=done, requested_iters=iters,
+                clean=bool(getattr(e, "_ck_clean_window", False)),
+                original=e,
+            ) from e
         return {
             "iters": iters,
             "fused": ladder > 0,
@@ -1642,7 +1721,14 @@ class Cores:
                                 )
 
                     t0q = time.perf_counter()
-                    w.stream_dispatch_async(run_chunk, depth)
+                    # inside a preflighted batch iteration the armed
+                    # driver-submit point already fired for every lane
+                    # BEFORE anything dispatched (compute_fused_batch);
+                    # firing again mid-phase would be a dirty cross-lane
+                    # failure containment could not repair
+                    w.stream_dispatch_async(
+                        run_chunk, depth,
+                        preflighted=self._batch_preflighted)
                     stall_s[0] += time.perf_counter() - t0q
                     n_submits[0] += 1
                 w.drain_stream_dispatch()

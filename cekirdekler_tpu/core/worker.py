@@ -124,12 +124,46 @@ class _DriverQueue:
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
 
-    def submit(self, fn: Callable[[], None], depth: int = 2) -> None:
+    def preflight(self) -> None:
+        """Run the submit-time failure checks WITHOUT queuing anything:
+        the armed ``driver-submit`` fault point and any pending closure
+        error both raise HERE.  The fused batch dispatch preflights
+        EVERY lane before queuing ANY lane's closure
+        (``Cores._dispatch_fused``), so a fault fired at this stage
+        leaves device iteration counts undiverged — the serving tier's
+        blast-radius containment can re-dispatch the residue bit-exactly
+        (``FusedBatchError.clean``).
+
+        The CLEAN marker is stamped HERE, per raise source: only the
+        injected fault is clean (it fired before anything was queued).
+        A pending error popped from the queue belongs to an EARLIER
+        closure — that closure's work never applied on this lane while
+        its iterations may already be counted applied, so re-dispatch
+        could silently corrupt: explicitly NOT clean."""
         if FAULTS.enabled:
+            try:
+                FAULTS.raise_if_fired("driver-submit", lane=self.lane,
+                                      where=self.name)
+            except Exception as e:  # noqa: BLE001 - marker, re-raised
+                e._ck_clean_window = True
+                raise
+        with self._cond:
+            if self._errors:
+                e = self._errors[0]
+                self._errors.clear()
+                e._ck_clean_window = False
+                raise e
+
+    def submit(self, fn: Callable[[], None], depth: int = 2,
+               preflighted: bool = False) -> None:
+        if FAULTS.enabled and not preflighted:
             # chaos plane (utils/faultinject.py): an armed driver-submit
             # clause makes THIS submit raise InjectedFaultError — the
             # fused window poisons and the error surfaces at the sync
-            # point, exactly like a real dispatch failure
+            # point, exactly like a real dispatch failure.  A caller
+            # that already ran :meth:`preflight` skips the fire so one
+            # dispatch costs the clause exactly one counted hit per
+            # lane either way (the determinism contract).
             FAULTS.raise_if_fired("driver-submit", lane=self.lane,
                                   where=self.name)
         with self._cond:
@@ -517,7 +551,20 @@ class Worker:
         self.coverage_epoch += 1
 
     # -- dispatch driver (fused path) ----------------------------------------
-    def dispatch_async(self, fn: Callable[[], None], depth: int = 2) -> None:
+    def dispatch_preflight(self) -> None:
+        """Fire this lane's submit-time failure checks (pending driver
+        errors + the armed ``driver-submit`` fault point) without
+        queuing — the fused batch dispatch runs this for EVERY lane
+        before queuing ANY closure, so a refusal cannot leave lanes
+        with diverged iteration counts (``_DriverQueue.preflight``)."""
+        if self._driver is None:
+            self._driver = _DriverQueue(
+                self._m_driver_depth, name=f"fused:lane{self.index}",
+                lane=self.index)
+        self._driver.preflight()
+
+    def dispatch_async(self, fn: Callable[[], None], depth: int = 2,
+                       preflighted: bool = False) -> None:
         """Queue a dispatch closure on this chip's FIFO driver thread
         (created lazily).  ``depth`` bounds the in-flight backlog PER
         CALL — a runtime retune of the caller's knob applies to the next
@@ -526,7 +573,7 @@ class Worker:
             self._driver = _DriverQueue(
                 self._m_driver_depth, name=f"fused:lane{self.index}",
                 lane=self.index)
-        self._driver.submit(fn, depth)
+        self._driver.submit(fn, depth, preflighted=preflighted)
 
     def drain_dispatch(self) -> None:
         """Wait until every queued dispatch closure has run (host-side),
@@ -536,7 +583,32 @@ class Worker:
             self._driver.drain()
 
     # -- stream driver (streamed-transfer path) ------------------------------
-    def stream_dispatch_async(self, fn: Callable[[], None], depth: int = 2) -> None:
+    def stream_preflight(self) -> None:
+        """Fire the stream driver's submit-time failure checks (armed
+        ``driver-submit`` fault point + pending closure errors) without
+        queuing — and WITHOUT creating the stream driver thread when
+        streaming never engaged.  ``compute_fused_batch`` runs this for
+        every lane before dispatching a per-call iteration, so an armed
+        fault fires while nothing of the iteration has reached any lane
+        (a CLEAN failure containment can re-dispatch)."""
+        # ckcheck: ok GIL-visible read between iterations — the caller
+        # is the single enqueue driver and no phase is in flight when
+        # it preflights (compute() joined every worker phase)
+        q = self._stream_driver
+        if q is not None:
+            q.preflight()
+            return
+        if FAULTS.enabled:
+            try:
+                FAULTS.raise_if_fired(
+                    "driver-submit", lane=self.index,
+                    where=f"stream:lane{self.index}")
+            except Exception as e:  # noqa: BLE001 - marker, re-raised
+                e._ck_clean_window = True
+                raise
+
+    def stream_dispatch_async(self, fn: Callable[[], None], depth: int = 2,
+                              preflighted: bool = False) -> None:
         """Queue a streamed-transfer closure (commit + launch + D2H
         issue) on this chip's STREAM driver thread — separate from the
         fused driver on purpose: these closures run while the submitter
@@ -548,7 +620,7 @@ class Worker:
             self._stream_driver = _DriverQueue(
                 self._m_stream_depth, name=f"stream:lane{self.index}",
                 lane=self.index)
-        self._stream_driver.submit(fn, depth)
+        self._stream_driver.submit(fn, depth, preflighted=preflighted)
 
     def drain_stream_dispatch(self) -> None:
         """Wait until every streamed-transfer closure has run (host-side

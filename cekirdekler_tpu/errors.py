@@ -79,6 +79,45 @@ class ClusterRetryExhausted(ClusterError):
         )
 
 
+class FusedBatchError(CekirdeklerError):
+    """An externally-assembled fused batch
+    (``Cores.compute_fused_batch``) failed mid-window — the serving
+    tier's containment input.  Instead of one opaque sync-point
+    exception, this carries everything blast-radius containment
+    (``serve/resilience.py``) needs to decide what is recoverable:
+
+    - ``cause`` — the NAMED failure cause (``injected:driver-submit``
+      for chaos-plane faults, else the original exception's type name);
+    - ``applied_iters`` — iterations of this batch that COMPLETED
+      dispatch before the failure (the per-call seed/engage iterations
+      plus any earlier flushed residue);
+    - ``requested_iters`` — the batch size asked for;
+    - ``clean`` — True when the failed residue was NOT partially
+      dispatched across lanes (the failure fired in the dispatch
+      preflight, before any lane's closure was queued), so re-dispatching
+      the residue is bit-exact.  ``clean=False`` means device state may
+      have diverged per lane — containment must fail the residue with a
+      named error rather than risk double-applying iterations;
+    - ``original`` — the underlying exception (``.lane`` is surfaced
+      when the cause names one, so per-lane breakers can attribute it).
+    """
+
+    def __init__(self, cause: str, applied_iters: int,
+                 requested_iters: int, clean: bool,
+                 original: BaseException):
+        self.cause = cause
+        self.applied_iters = int(applied_iters)
+        self.requested_iters = int(requested_iters)
+        self.clean = bool(clean)
+        self.original = original
+        self.lane = getattr(original, "lane", None)
+        super().__init__(
+            f"fused batch failed ({cause}) after "
+            f"{applied_iters}/{requested_iters} iteration(s) applied; "
+            f"{'clean' if clean else 'NOT clean'} residue: {original}"
+        )
+
+
 class InjectedFaultError(CekirdeklerError):
     """A DELIBERATELY injected fault fired (``utils/faultinject.py``,
     armed by ``CK_FAULTS``) — named so chaos tests and postmortems can

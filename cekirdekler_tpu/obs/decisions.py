@@ -107,6 +107,10 @@ DECISION_KINDS = (
     "scheduler-rotation",  # bench.SectionScheduler — fairness promotion
     "admission",           # serve/admission — one request admitted/rejected
     "coalesce",            # serve/coalescer — one dispatch cycle's batch plan
+    "breaker",             # serve/resilience — a circuit breaker transitioned
+    "shed",                # serve/resilience — brownout engaged/released
+    "retry",               # serve/resilience — one budget-gated retry verdict
+    "containment",         # serve/resilience — a failed batch's bisection plan
     "drain-apply",         # obs/drain — lanes quarantined (advice became action)
     "readmit",             # obs/drain — quarantined lanes re-admitted
     "member-leave",        # cluster/elastic — a member departed, re-split
@@ -121,6 +125,7 @@ DECISION_KINDS = (
 REPLAYABLE_KINDS = (
     "load-balance", "transfer-choose", "transfer-observe", "health-verdict",
     "admission", "coalesce",
+    "breaker", "shed", "retry", "containment",
     "drain-apply", "readmit", "member-leave", "member-join",
 )
 

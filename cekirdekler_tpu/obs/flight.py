@@ -92,6 +92,7 @@ EVENT_KINDS = (
     "debug-server", "debug-port-skipped",
     "profiler-start", "profiler-stop",
     "fault-injected",
+    "serve-contain", "breaker-flip", "brownout", "serve-crash",
     "drain-apply", "readmit", "drain-probe",
     "member-leave", "member-join",
     "checkpoint-restore", "checkpoint-fallback", "checkpoint-sweep",

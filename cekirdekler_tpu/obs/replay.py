@@ -308,10 +308,16 @@ def _replay_admission(inp: dict, out: dict) -> dict:
         max_queue_depth=int(inp["max_queue_depth"]),
         healthy=bool(inp["healthy"]),
         est_batch_s=float(inp["est_batch_s"]),
-        # kernel-verifier inputs arrived with the ckprove gate; older
+        # kernel-verifier inputs arrived with the ckprove gate, the
+        # breaker/brownout inputs with the resilience layer; older
         # logs lack them — replay with the pre-gate defaults
         kernel_unsafe=bool(inp.get("kernel_unsafe", False)),
         kernel_finding=inp.get("kernel_finding"),
+        breaker_open=bool(inp.get("breaker_open", False)),
+        breaker_retry_after_s=inp.get("breaker_retry_after_s"),
+        brownout=bool(inp.get("brownout", False)),
+        shed_quota=inp.get("shed_quota"),
+        priority=int(inp.get("priority", 1)),
     )
     mism: dict = {}
     for k in ("admit", "reason", "retry_after_s"):
@@ -330,6 +336,77 @@ def _replay_coalesce(inp: dict, out: dict) -> dict:
     mism: dict = {}
     for k in ("order", "picked", "promoted"):
         gv, ev = list(got.get(k) or ()), list(out.get(k) or ())
+        if gv != ev:
+            mism[k] = {"expected": ev, "got": gv}
+    return mism
+
+
+def _replay_breaker(inp: dict, out: dict) -> dict:
+    """breaker: one circuit-breaker transition or admit
+    (serve/resilience.py) — both pure, dispatched on the recorded
+    ``op``."""
+    from ..serve.resilience import breaker_admit, breaker_transition
+
+    if inp.get("op") == "admit":
+        got = breaker_admit(
+            inp.get("state") or {}, float(inp["now"]),
+            float(inp["open_s"]))
+        keys = ("state", "action", "allow", "probe", "retry_after_s")
+    else:
+        got = breaker_transition(
+            inp.get("state") or {}, str(inp["event"]),
+            float(inp["now"]), int(inp["threshold"]),
+            float(inp["open_s"]))
+        keys = ("state", "action")
+    mism: dict = {}
+    for k in keys:
+        if got.get(k) != out.get(k):
+            mism[k] = {"expected": out.get(k), "got": got.get(k)}
+    return mism
+
+
+def _replay_shed(inp: dict, out: dict) -> dict:
+    from ..serve.resilience import brownout_transition
+
+    got = brownout_transition(
+        inp.get("state") or {}, int(inp["queue_depth"]),
+        int(inp["watermark"]), int(inp["clear_mark"]),
+        int(inp["open_breakers"]), int(inp["drained_lanes"]),
+        engage_streak=int(inp.get("engage_streak", 2)))
+    mism: dict = {}
+    for k in ("active", "streak", "pressure", "changed"):
+        if got.get(k) != out.get(k):
+            mism[k] = {"expected": out.get(k), "got": got[k]}
+    return mism
+
+
+def _replay_retry(inp: dict, out: dict) -> dict:
+    from ..serve.resilience import retry_decision
+
+    got = retry_decision(
+        int(inp["attempt"]), int(inp["max_attempts"]),
+        float(inp["tokens"]),
+        (None if inp.get("deadline_left_s") is None
+         else float(inp["deadline_left_s"])),
+        float(inp["base_s"]), float(inp["cap_s"]),
+        float(inp["jitter_u"]))
+    mism: dict = {}
+    for k in ("retry", "delay_s", "reason"):
+        if got.get(k) != out.get(k):
+            mism[k] = {"expected": out.get(k), "got": got.get(k)}
+    return mism
+
+
+def _replay_containment(inp: dict, out: dict) -> dict:
+    from ..serve.resilience import containment_plan
+
+    got = containment_plan(int(inp["k"]), leaf=int(inp.get("leaf", 1)))
+    mism: dict = {}
+    for k in ("mode", "parts"):
+        gv = got.get(k)
+        ev = out.get(k)
+        gv = list(gv) if isinstance(gv, (list, tuple)) else gv
+        ev = list(ev) if isinstance(ev, (list, tuple)) else ev
         if gv != ev:
             mism[k] = {"expected": ev, "got": gv}
     return mism
@@ -390,6 +467,10 @@ _REPLAYERS = {
     "health-verdict": _replay_health_verdict,
     "admission": _replay_admission,
     "coalesce": _replay_coalesce,
+    "breaker": _replay_breaker,
+    "shed": _replay_shed,
+    "retry": _replay_retry,
+    "containment": _replay_containment,
     "drain-apply": _replay_drain,
     "readmit": _replay_drain,
     "member-leave": _replay_member,

@@ -11,6 +11,13 @@ never a silent drop) and consults the lane-health verdicts, and a
 coalescing scheduler groups same-signature requests into batches that
 dispatch as fused windows — the shape-only executable cache makes a
 coalesced batch ONE ladder launch, so request coalescing IS batching.
+
+The resilience layer (``serve/resilience.py``, docs/RESILIENCE.md)
+contains the blast radius of every failure: a poisoned fused batch is
+bisected so exactly the faulty request fails with a named cause,
+retries are deadline-aware and budget-gated, circuit breakers refuse a
+failing (tenant, job-signature) with an honest retry hint, and
+brownout shedding keeps p99 alive under sustained degradation.
 """
 
 from .admission import (
@@ -21,10 +28,23 @@ from .admission import (
 )
 from .coalescer import STARVE_ROUNDS, plan_coalesce
 from .frontend import ServeFrontend, ServeJob, servez_payload
+from .resilience import (
+    BreakerBoard,
+    ResilienceConfig,
+    RetryBudgets,
+    breaker_admit,
+    breaker_transition,
+    brownout_transition,
+    containment_plan,
+    retry_decision,
+)
 from .tenants import TenantTable
 
 __all__ = [
     "AdmissionController",
+    "BreakerBoard",
+    "ResilienceConfig",
+    "RetryBudgets",
     "ServeFrontend",
     "ServeJob",
     "ServeRejected",
@@ -32,6 +52,11 @@ __all__ = [
     "TenantTable",
     "STARVE_ROUNDS",
     "admit_decision",
+    "breaker_admit",
+    "breaker_transition",
+    "brownout_transition",
+    "containment_plan",
     "plan_coalesce",
+    "retry_decision",
     "servez_payload",
 ]

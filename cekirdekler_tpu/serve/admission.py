@@ -25,9 +25,15 @@ Check order (the contract, pinned by test):
    lane degraded (``HealthMonitor.healthy()`` false — the same verdict
    ``/healthz`` serves as 503) nothing is admitted; retry-after backs
    off hardest.
-2. **queue depth** — the global pending-request bound; the tier sheds
+2. **circuit breaker** — this (tenant, job-signature)'s breaker is
+   open (``serve/resilience.py``): the job class is failing, and the
+   hint is the HONEST remaining open window.
+3. **queue depth** — the global pending-request bound; the tier sheds
    load before its latency collapses (backpressure, not buffering).
-3. **tenant quota** — per-tenant in-flight concurrency cap; one noisy
+4. **brownout** — under sustained degradation the tier sheds
+   over-quota / lowest-priority traffic (named, never silent; a tenant
+   with nothing in flight is never shed).
+5. **tenant quota** — per-tenant in-flight concurrency cap; one noisy
    tenant cannot starve the rest.
 
 ``retry_after_s`` is a deterministic function of the same inputs
@@ -49,11 +55,14 @@ __all__ = [
     "ServeRejected",
     "TenantQuota",
     "admit_decision",
+    "brownout_share",
     "MODEL_INVARIANTS",
     "REJECT_HEALTH",
     "REJECT_QUEUE",
     "REJECT_QUOTA",
     "REJECT_KERNEL",
+    "REJECT_BREAKER",
+    "REJECT_BROWNOUT",
 ]
 
 #: Named rejection reasons (the ``ck_serve_rejected_total{reason}``
@@ -65,6 +74,15 @@ REJECT_QUOTA = "tenant-quota"
 #: refuted the job's kernels/flags: a structurally unsafe job — no
 #: retry hint helps, the kernel or its flags must change.
 REJECT_KERNEL = "kernel-unsafe"
+#: This (tenant, job-signature)'s circuit breaker is OPEN
+#: (``serve/resilience.py``): the job class is failing, and the hint is
+#: the remaining open window — honest, not exponential guesswork.
+REJECT_BREAKER = "circuit-open"
+#: Brownout shedding (``serve/resilience.py``): the tier is under
+#: sustained degradation and this request is over the tenant's reduced
+#: brownout share (or the tenant is lowest-priority) — shed with a
+#: named reason instead of letting p99 collapse for everyone.
+REJECT_BROWNOUT = "brownout"
 
 #: Floor for retry-after hints: even an instant-drain tier should not
 #: invite a reject/retry busy-loop.
@@ -86,23 +104,27 @@ MODEL_INVARIANTS = (
      "sheds load before latency collapses"),
     ("reject-order", "safety",
      "rejection reasons follow the pinned check order — kernel "
-     "soundness, then health, then queue depth, then tenant quota; a "
-     "reject names the FIRST failing gate"),
+     "soundness, then health, then the circuit breaker, then queue "
+     "depth, then brownout shedding, then tenant quota; a reject "
+     "names the FIRST failing gate"),
     ("retry-hint", "safety",
      "every backoff-able rejection carries retry_after_s >= the "
-     "anti-busy-loop floor; kernel-unsafe carries exactly 0.0 (no "
-     "backoff makes a refuted kernel admissible)"),
+     "anti-busy-loop floor (the breaker's is its honest remaining "
+     "open window); kernel-unsafe carries exactly 0.0 (no backoff "
+     "makes a refuted kernel admissible)"),
     ("admit-iff", "safety",
-     "admit is exactly the conjunction of the four gates: no hidden "
+     "admit is exactly the conjunction of the six gates: no hidden "
      "input changes the verdict, no gate is skipped"),
 )
 
 
 class ServeRejected(CekirdeklerError):
     """A submit refused by admission — carries the named ``reason``
-    (:data:`REJECT_HEALTH` / :data:`REJECT_QUEUE` / :data:`REJECT_QUOTA`)
-    and the ``retry_after_s`` hint.  Raised, never silently dropped:
-    the client always learns why and when to come back."""
+    (:data:`REJECT_HEALTH` / :data:`REJECT_BREAKER` /
+    :data:`REJECT_QUEUE` / :data:`REJECT_BROWNOUT` /
+    :data:`REJECT_QUOTA` / :data:`REJECT_KERNEL`) and the
+    ``retry_after_s`` hint.  Raised, never silently dropped: the
+    client always learns why and when to come back."""
 
     def __init__(self, tenant: str, reason: str, retry_after_s: float):
         self.tenant = tenant
@@ -117,9 +139,21 @@ class ServeRejected(CekirdeklerError):
 @dataclass(frozen=True)
 class TenantQuota:
     """Per-tenant admission limits.  ``max_inflight`` bounds the
-    tenant's admitted-but-not-completed requests (queued + dispatched)."""
+    tenant's admitted-but-not-completed requests (queued + dispatched);
+    ``priority`` orders brownout shedding (``<= 0`` = lowest priority:
+    under brownout the tenant keeps exactly one request in flight)."""
 
     max_inflight: int = 64
+    priority: int = 1
+
+
+def brownout_share(quota: int, frac: float = 0.5) -> int:
+    """A tenant's effective quota under brownout: ``quota · frac``,
+    floored at 1 (the starvation floor).  The ONE shed-quota formula —
+    the controller, the pure gate's fallback, and the model-checker
+    machines all call this, so the exhaustive proofs cover exactly
+    what a non-default ``shed_frac`` deployment runs."""
+    return max(1, int(int(quota) * float(frac)))
 
 
 def admit_decision(
@@ -131,6 +165,11 @@ def admit_decision(
     est_batch_s: float,
     kernel_unsafe: bool = False,
     kernel_finding: str | None = None,
+    breaker_open: bool = False,
+    breaker_retry_after_s: float | None = None,
+    brownout: bool = False,
+    shed_quota: int | None = None,
+    priority: int = 1,
 ) -> dict:
     """The PURE admission transition (replay-verified — see module
     docstring for the check order).  Returns ``{"admit", "reason",
@@ -140,7 +179,16 @@ def admit_decision(
     ``kernel_unsafe`` is checked FIRST: a job the kernel verifier
     refuted (``kernel_finding`` names the verdict kind) is structurally
     broken — no backoff makes it admissible, so ``retry_after_s`` is
-    0.0 (do not retry as-is)."""
+    0.0 (do not retry as-is).
+
+    ``breaker_open``/``breaker_retry_after_s`` come from the frontend's
+    :class:`~.resilience.BreakerBoard` admit for this (tenant,
+    job-signature); ``brownout``/``shed_quota``/``priority`` from its
+    brownout controller — all recorded as decision INPUTS, so the new
+    rejections replay bit-identically (defaults preserve pre-resilience
+    logs).  The brownout gate never sheds a tenant with zero in-flight
+    requests (``shed_quota`` floors at 1 — the starvation floor the
+    model checker proves)."""
     base = max(float(est_batch_s), _RETRY_FLOOR_S)
     if kernel_unsafe:
         return {"admit": False, "reason": REJECT_KERNEL,
@@ -150,6 +198,13 @@ def admit_decision(
         # windows, not more traffic
         return {"admit": False, "reason": REJECT_HEALTH,
                 "retry_after_s": base * 4.0}
+    if breaker_open:
+        # the breaker's hint is HONEST: the remaining open window, not
+        # a generic backoff (floored against busy-loops)
+        hint = (float(breaker_retry_after_s)
+                if breaker_retry_after_s is not None else base * 4.0)
+        return {"admit": False, "reason": REJECT_BREAKER,
+                "retry_after_s": max(_RETRY_FLOOR_S, hint)}
     if queue_depth >= max_queue_depth:
         # the deeper past the bound the caller found the queue, the
         # longer the honest drain estimate
@@ -157,6 +212,14 @@ def admit_decision(
         frac = overflow / max(max_queue_depth, 1)
         return {"admit": False, "reason": REJECT_QUEUE,
                 "retry_after_s": base * (1.0 + frac)}
+    if brownout:
+        sq = (max(1, int(shed_quota)) if shed_quota is not None
+              else brownout_share(quota))
+        if int(priority) <= 0:
+            sq = 1  # lowest priority keeps exactly one in flight
+        if tenant_inflight >= sq:
+            return {"admit": False, "reason": REJECT_BROWNOUT,
+                    "retry_after_s": base * 2.0}
     if tenant_inflight >= quota:
         # one batch cycle typically retires quota-bounded work
         return {"admit": False, "reason": REJECT_QUOTA,
@@ -179,6 +242,7 @@ class AdmissionController:
         default_quota: TenantQuota | int | None = None,
         health=None,
         health_ttl_s: float = 0.05,
+        shed_frac: float = 0.5,
     ):
         if isinstance(default_quota, int):
             default_quota = TenantQuota(max_inflight=default_quota)
@@ -186,6 +250,11 @@ class AdmissionController:
         self.max_queue_depth = max(1, int(max_queue_depth))
         self._health = health  # callable -> bool; None = always healthy
         self.health_ttl_s = float(health_ttl_s)
+        # brownout: each tenant's effective quota drops to
+        # ceil-ish(quota * shed_frac), floored at 1 (the starvation
+        # floor) — a frontend-constructed controller inherits the
+        # ResilienceConfig knob
+        self.shed_frac = float(shed_frac)
         self._mu = threading.Lock()
         self._quotas: dict[str, TenantQuota] = {}
         self._health_cache: tuple[float, bool] = (-1e18, True)
@@ -225,6 +294,9 @@ class AdmissionController:
         est_batch_s: float,
         kernel_unsafe: bool = False,
         kernel_finding: str | None = None,
+        breaker_open: bool = False,
+        breaker_retry_after_s: float | None = None,
+        brownout: bool = False,
     ) -> dict:
         """One admission decision for ``tenant``, recorded with its
         complete inputs (kind ``admission``).  Returns the
@@ -233,10 +305,15 @@ class AdmissionController:
 
         ``kernel_unsafe``/``kernel_finding`` come from the caller's
         kernel-verifier gate (``ServeFrontend.submit`` under
-        ``CK_KERNEL_VERIFY=strict``) and enter the decision record as
-        INPUTS, so a ``kernel-unsafe`` rejection replays bit-identically
-        offline — a tenant disputing it is answered from the log."""
-        quota = self.quota_of(tenant).max_inflight
+        ``CK_KERNEL_VERIFY=strict``), ``breaker_open``/
+        ``breaker_retry_after_s``/``brownout`` from the frontend's
+        resilience layer (``serve/resilience.py``) — all enter the
+        decision record as INPUTS, so every named rejection replays
+        bit-identically offline: a tenant disputing one is answered
+        from the log."""
+        q = self.quota_of(tenant)
+        quota, priority = q.max_inflight, q.priority
+        shed_quota = brownout_share(quota, self.shed_frac)
         healthy = self.healthy()
         dec = admit_decision(
             tenant_inflight=int(tenant_inflight), quota=int(quota),
@@ -245,6 +322,10 @@ class AdmissionController:
             healthy=healthy, est_batch_s=float(est_batch_s),
             kernel_unsafe=bool(kernel_unsafe),
             kernel_finding=kernel_finding,
+            breaker_open=bool(breaker_open),
+            breaker_retry_after_s=breaker_retry_after_s,
+            brownout=bool(brownout), shed_quota=shed_quota,
+            priority=int(priority),
         )
         if DECISIONS.enabled:
             # the complete replay inputs — a rejected tenant's dispute
@@ -260,5 +341,12 @@ class AdmissionController:
                 "kernel_unsafe": bool(kernel_unsafe),
                 "kernel_finding": (None if kernel_finding is None
                                    else str(kernel_finding)),
+                "breaker_open": bool(breaker_open),
+                "breaker_retry_after_s": (
+                    None if breaker_retry_after_s is None
+                    else float(breaker_retry_after_s)),
+                "brownout": bool(brownout),
+                "shed_quota": int(shed_quota),
+                "priority": int(priority),
             }, dict(dec))
         return dec

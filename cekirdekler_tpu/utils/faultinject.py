@@ -25,7 +25,11 @@ probabilistic draws.  Each fault clause is
   (the barrier's per-lane fence sleeps ``delay_ms``), ``slow-link``
   (worker H2D/D2H transfers run ``factor``× slower — the injected
   delay is ``(factor-1) × measured wall + delay_ms``), ``socket-drop``
-  (a cluster socket send/recv disconnects mid-message).
+  (a cluster socket send/recv disconnects mid-message),
+  ``serve-dispatch`` (a serving-tier dispatch part raises
+  :class:`~cekirdekler_tpu.errors.InjectedFaultError` INSIDE the
+  frontend's dispatch cycle, before anything reaches a driver queue —
+  the blast-radius-containment/retry-budget chaos seam).
 - **selector** — ``lane<N>`` matches only that lane's sites; any other
   token matches the site's ``where`` tag (``send``/``recv`` for
   sockets).  Absent = every matching site.
@@ -82,6 +86,7 @@ FAULT_POINTS = (
     "lane-stall",      # core/cores.Cores.barrier — per-lane fence sleeps
     "slow-link",       # core/worker transfers — Nx slowdown
     "socket-drop",     # cluster/netbuffer send/recv — disconnect mid-message
+    "serve-dispatch",  # serve/frontend dispatch cycle — the part raises
 )
 
 

@@ -62,6 +62,7 @@ from cekirdekler_tpu.obs.replay import (  # noqa: E402
 )
 from cekirdekler_tpu.serve import admission as A  # noqa: E402
 from cekirdekler_tpu.serve import coalescer as C  # noqa: E402
+from cekirdekler_tpu.serve import resilience as R  # noqa: E402
 
 import tools.ckmodel.cli as ckmodel_cli  # noqa: E402
 from tools.ckmodel import purity  # noqa: E402
@@ -386,8 +387,150 @@ def _balance_machine(alphabet=(1.0, 5.0), **kw):
                             horizon=24, **kw)
 
 
+# -- resilience (serve/resilience.py) fixtures ------------------------------
+
+def _double_probe_admit(state, now, open_s):
+    """Half-open admits a SECOND probe while one is in flight."""
+    out = R.breaker_admit(state, now, open_s)
+    if state.get("state") == R.BREAKER_HALF_OPEN \
+            and state.get("probe_inflight"):
+        st = dict(out["state"])
+        return dict(out, allow=True, probe=True, retry_after_s=None,
+                    state=st)
+    return out
+
+
+def _eager_open(state, event, now, threshold, open_s):
+    """Opens on the FIRST failure (threshold filed down to 1)."""
+    out = R.breaker_transition(state, event, now, threshold, open_s)
+    if state.get("state") == R.BREAKER_CLOSED and event == "failure" \
+            and out["action"] is None:
+        st = dict(out["state"], state=R.BREAKER_OPEN, opened_t=now)
+        return {"state": st, "action": "opened"}
+    return out
+
+
+def _dishonest_hint(state, now, open_s):
+    """Refusals carry a made-up hint instead of the remaining window."""
+    out = R.breaker_admit(state, now, open_s)
+    if not out["allow"]:
+        return dict(out, retry_after_s=999.0)
+    return out
+
+
+def _never_half_open(state, now, open_s):
+    """The open window never times out — admits are refused forever."""
+    if state.get("state") == R.BREAKER_OPEN:
+        return {"allow": False, "probe": False,
+                "retry_after_s": float(open_s) / 2.0,
+                "state": dict(state), "action": None}
+    return R.breaker_admit(state, now, open_s)
+
+
+def _probe_never_closes(state, event, now, threshold, open_s):
+    """A successful probe re-opens instead of closing (permanent open
+    under all-ok inputs)."""
+    out = R.breaker_transition(state, event, now, threshold, open_s)
+    if state.get("state") == R.BREAKER_HALF_OPEN and event == "success":
+        st = dict(out["state"], state=R.BREAKER_OPEN, opened_t=now,
+                  probe_inflight=False)
+        return {"state": st, "action": "reopened"}
+    return out
+
+
+def _breaker_machine(**kw):
+    return M.BreakerMachine(threshold=2, open_ticks=2, **kw)
+
+
+def _hair_trigger_shed(state, qd, wm, cm, ob, dl, engage_streak=2):
+    """Engages on the FIRST pressured evaluation — the hysteresis the
+    pressure gate exists to enforce, filed off."""
+    out = R.brownout_transition(state, qd, wm, cm, ob, dl,
+                                engage_streak=engage_streak)
+    if not state.get("active") and out["pressure"] and not out["active"]:
+        return dict(out, active=True, streak=0, changed=True)
+    return out
+
+
+def _sticky_shed(state, qd, wm, cm, ob, dl, engage_streak=2):
+    """Never releases: degraded mode is permanent."""
+    out = R.brownout_transition(state, qd, wm, cm, ob, dl,
+                                engage_streak=engage_streak)
+    if state.get("active"):
+        return dict(out, active=True, changed=False)
+    return out
+
+
+def _shed_everyone(**kw):
+    """Sheds even a tenant with ZERO requests in flight."""
+    dec = A.admit_decision(**kw)
+    if kw.get("brownout") and dec["admit"]:
+        return {"admit": False, "reason": A.REJECT_BROWNOUT,
+                "retry_after_s": 0.1}
+    return dec
+
+
+def _anonymous_shed(**kw):
+    """Brownout rejections renamed to the quota reason (and a
+    busy-loop hint)."""
+    dec = A.admit_decision(**kw)
+    if dec.get("reason") == A.REJECT_BROWNOUT:
+        return dict(dec, reason=A.REJECT_QUOTA, retry_after_s=0.0)
+    return dec
+
+
+def _shed_machine(**kw):
+    return M.ShedMachine(engage_streak=2, **kw)
+
+
+def _budgetless_retry(attempt, max_attempts, tokens, deadline_left_s,
+                      base_s, cap_s, jitter_u):
+    """Grants retries with an empty budget and past max_attempts —
+    the retry storm the budget exists to prevent."""
+    rd = R.retry_decision(attempt, max_attempts, tokens,
+                          deadline_left_s, base_s, cap_s, jitter_u)
+    if not rd["retry"] and rd["reason"] in ("budget-exhausted",
+                                            "attempts-exhausted"):
+        return {"retry": True, "delay_s": base_s, "reason": None}
+    return rd
+
+
+def _unbounded_backoff(attempt, max_attempts, tokens, deadline_left_s,
+                       base_s, cap_s, jitter_u):
+    """Backoff cap filed off: granted delays blow past 1.5×cap (and
+    any deadline)."""
+    rd = R.retry_decision(attempt, max_attempts, tokens,
+                          deadline_left_s, base_s, cap_s, jitter_u)
+    if rd["retry"]:
+        return dict(rd, delay_s=10.0 * cap_s)
+    return rd
+
+
+def _retry_machine(**kw):
+    return M.RetryMachine(max_attempts=2, budget_cap=2, **kw)
+
+
 #: invariant id -> machine factory with the broken seam injected.
 BROKEN_FIXTURES = {
+    "breaker-half-open-one-probe":
+        lambda: _breaker_machine(admit=_double_probe_admit),
+    "breaker-opens-on-threshold":
+        lambda: _breaker_machine(transition=_eager_open),
+    "breaker-honest-hint":
+        lambda: _breaker_machine(admit=_dishonest_hint),
+    "breaker-open-times-out":
+        lambda: _breaker_machine(admit=_never_half_open),
+    "breaker-recovers-on-ok":
+        lambda: _breaker_machine(transition=_probe_never_closes),
+    "shed-pressure-gated":
+        lambda: _shed_machine(transition=_hair_trigger_shed),
+    "shed-quota-floor": lambda: _shed_machine(decide=_shed_everyone),
+    "shed-named-hint": lambda: _shed_machine(decide=_anonymous_shed),
+    "shed-releases": lambda: _shed_machine(transition=_sticky_shed),
+    "retry-budget-bounded":
+        lambda: _retry_machine(decide=_budgetless_retry),
+    "retry-backoff-bounded":
+        lambda: _retry_machine(decide=_unbounded_backoff),
     "availability-floor": lambda: _drain_machine(transition=_no_floor),
     "share-conservation": lambda: _drain_machine(masker=_leaky_masker),
     "quarantine-masked":
@@ -428,7 +571,7 @@ BROKEN_FIXTURES = {
 
 def test_fixture_table_covers_every_declared_invariant():
     declared = set()
-    for mod in (D, E, A, C, B):
+    for mod in (D, E, A, C, B, R):
         declared |= {row[0] for row in mod.MODEL_INVARIANTS}
     assert set(BROKEN_FIXTURES) == declared
 

@@ -37,6 +37,8 @@ HEADLINE = {
     "serve_p99_ms": 40.0,
     "serve_goodput_rps": 400.0,
     "serve_coalesce_ratio": 4.0,
+    "serve_chaos_goodput_frac": 0.9,
+    "serve_chaos_p99_ms": 60.0,
     "drain_recover_ms": 900.0,
     "rejoin_converge_iters": 4.0,
 }

@@ -1,0 +1,733 @@
+"""Serving-tier resilience (ISSUE 15, serve/resilience.py): pure
+breaker/shed/retry machines, blast-radius containment down to the
+faulty request, retry budgets under a seeded flaky fault, circuit
+breakers wired into admission, brownout shedding, dispatcher crash
+containment, shutdown racing an in-flight retry, the chaos drill, and
+decision replay for every new kind.
+
+The inc kernel adds exactly 1.0f — small-integer f32 arithmetic is
+exact, so every lost, duplicated, or half-applied request shows as an
+integer-sized error and the assertions demand bit equality (the
+test_serve.py discipline, applied to the failure paths)."""
+
+import importlib.util
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from cekirdekler_tpu import ClArray
+from cekirdekler_tpu.core import NumberCruncher
+from cekirdekler_tpu.errors import (
+    CekirdeklerError,
+    FusedBatchError,
+    InjectedFaultError,
+)
+from cekirdekler_tpu.hardware import platforms
+from cekirdekler_tpu.metrics.registry import REGISTRY
+from cekirdekler_tpu.obs.decisions import DECISIONS
+from cekirdekler_tpu.obs.replay import verify_records
+from cekirdekler_tpu.serve import (
+    AdmissionController,
+    ResilienceConfig,
+    ServeFrontend,
+    ServeJob,
+    ServeRejected,
+    TenantQuota,
+    admit_decision,
+    breaker_admit,
+    breaker_transition,
+    brownout_transition,
+    containment_plan,
+    retry_decision,
+)
+from cekirdekler_tpu.serve.admission import (
+    REJECT_BREAKER,
+    REJECT_BROWNOUT,
+    REJECT_HEALTH,
+    REJECT_QUEUE,
+    REJECT_QUOTA,
+)
+from cekirdekler_tpu.serve.resilience import (
+    BREAKER_CLOSED,
+    BREAKER_HALF_OPEN,
+    BREAKER_OPEN,
+    breaker_init,
+)
+from cekirdekler_tpu.utils.faultinject import FAULTS
+
+INC = """
+__kernel void inc(__global float* a) {
+    int i = get_global_id(0);
+    a[i] = a[i] + 1.0f;
+}
+"""
+
+
+@pytest.fixture(scope="module")
+def devs():
+    return platforms().cpus()
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    FAULTS.disarm()
+    yield
+    FAULTS.disarm()
+
+
+def _mk(devs, n=1024, lanes=2, **fe_kw):
+    cr = NumberCruncher(devs.subset(lanes), INC)
+    x = ClArray(np.zeros(n, np.float32), name="rx")
+    x.partial_read = True
+    job = ServeJob(params=[x], kernels=["inc"], compute_id=800,
+                   global_range=n, local_range=64)
+    fe = ServeFrontend(cr, autostart=False, name="resil", **fe_kw)
+    return cr, x, job, fe
+
+
+# ---------------------------------------------------------------------------
+# the pure machines
+# ---------------------------------------------------------------------------
+
+def test_breaker_lifecycle_pure():
+    st = breaker_init()
+    # 4 failures at threshold 5: still closed
+    for k in range(4):
+        r = breaker_transition(st, "failure", float(k), 5, 1.0)
+        st = r["state"]
+        assert st["state"] == BREAKER_CLOSED and r["action"] is None
+    r = breaker_transition(st, "failure", 4.0, 5, 1.0)
+    st = r["state"]
+    assert st["state"] == BREAKER_OPEN and r["action"] == "opened"
+    # inside the open window: refused with the HONEST remaining time
+    a = breaker_admit(st, 4.25, 1.0)
+    assert a["allow"] is False
+    assert a["retry_after_s"] == pytest.approx(0.75)
+    # past the window: the next admit IS the probe, exactly one
+    a = breaker_admit(st, 5.5, 1.0)
+    assert a["allow"] is True and a["probe"] is True
+    st = a["state"]
+    assert st["state"] == BREAKER_HALF_OPEN
+    a2 = breaker_admit(st, 5.6, 1.0)
+    assert a2["allow"] is False  # one probe in flight
+    # probe success closes; probe failure re-opens
+    r = breaker_transition(st, "success", 5.7, 5, 1.0)
+    assert r["state"]["state"] == BREAKER_CLOSED and r["action"] == "closed"
+    r = breaker_transition(st, "failure", 5.7, 5, 1.0)
+    assert r["state"]["state"] == BREAKER_OPEN and r["action"] == "reopened"
+    # a success mid-run resets the consecutive count
+    st = breaker_init()
+    st = breaker_transition(st, "failure", 0.0, 2, 1.0)["state"]
+    st = breaker_transition(st, "success", 0.1, 2, 1.0)["state"]
+    st = breaker_transition(st, "failure", 0.2, 2, 1.0)["state"]
+    assert st["state"] == BREAKER_CLOSED
+
+
+def test_breaker_open_rearm_past_window_pure():
+    """A failure arriving AFTER the open window expired re-arms it:
+    lane breakers are never admit-gated, so without the re-arm a
+    persistently failing lane would read timed-out-open forever and
+    its brownout pressure signal would die after one window."""
+    st = breaker_init()
+    for k in range(2):
+        st = breaker_transition(st, "failure", float(k), 2, 1.0)["state"]
+    assert st["state"] == BREAKER_OPEN and st["opened_t"] == 1.0
+    # inside the window: stale outcome, window NOT extended
+    r = breaker_transition(st, "failure", 1.5, 2, 1.0)
+    assert r["action"] is None and r["state"]["opened_t"] == 1.0
+    # past the window: re-armed, visible as a transition
+    r = breaker_transition(st, "failure", 2.5, 2, 1.0)
+    assert r["action"] == "reopened" and r["state"]["opened_t"] == 2.5
+
+
+def test_brownout_hysteresis_pure():
+    st = {"active": False, "streak": 0}
+    # one pressured evaluation does not engage (engage_streak=2)
+    r = brownout_transition(st, 10, 8, 4, 0, 0, engage_streak=2)
+    assert r["active"] is False and r["streak"] == 1 and r["pressure"]
+    r = brownout_transition(r, 10, 8, 4, 0, 0, engage_streak=2)
+    assert r["active"] is True and r["changed"] is True
+    # secondary signals need a non-trivial queue: open breakers with an
+    # EMPTY queue are not pressure
+    r2 = brownout_transition(
+        {"active": False, "streak": 0}, 0, 8, 4, 3, 1, engage_streak=2)
+    assert r2["pressure"] is False
+    r2 = brownout_transition(
+        {"active": False, "streak": 0}, 5, 8, 4, 1, 0, engage_streak=2)
+    assert r2["pressure"] is True  # breaker + queue past clear mark
+    # release needs the same streak of clear evaluations
+    r = brownout_transition(r, 0, 8, 4, 0, 0, engage_streak=2)
+    assert r["active"] is True and r["streak"] == 1
+    r = brownout_transition(r, 0, 8, 4, 0, 0, engage_streak=2)
+    assert r["active"] is False and r["changed"] is True
+
+
+def test_retry_decision_pure():
+    # deterministic: the jitter rides as an input
+    a = retry_decision(0, 2, 5.0, None, 0.01, 0.08, 0.5)
+    assert a == retry_decision(0, 2, 5.0, None, 0.01, 0.08, 0.5)
+    assert a["retry"] is True
+    assert a["delay_s"] == pytest.approx(0.01)  # base * (0.5 + 0.5)
+    # exponential, capped at cap_s (pre-jitter)
+    b = retry_decision(4, 9, 5.0, None, 0.01, 0.08, 0.999)
+    assert b["delay_s"] <= 1.5 * 0.08
+    # the three named refusals
+    assert retry_decision(2, 2, 5.0, None, 0.01, 0.08, 0.0)["reason"] \
+        == "attempts-exhausted"
+    assert retry_decision(0, 2, 0.5, None, 0.01, 0.08, 0.0)["reason"] \
+        == "budget-exhausted"
+    assert retry_decision(0, 2, 5.0, 0.001, 0.01, 0.08, 0.0)["reason"] \
+        == "deadline"
+
+
+def test_containment_plan_pure():
+    assert containment_plan(8) == {"mode": "bisect", "parts": [4, 4]}
+    assert containment_plan(7) == {"mode": "bisect", "parts": [4, 3]}
+    assert containment_plan(1) == {"mode": "per-request", "parts": [1]}
+    assert containment_plan(3, leaf=4) == {
+        "mode": "per-request", "parts": [1, 1, 1]}
+    for k in range(1, 40):
+        assert sum(containment_plan(k)["parts"]) == k
+
+
+# ---------------------------------------------------------------------------
+# admission gates: breaker + brownout order and hints
+# ---------------------------------------------------------------------------
+
+def test_admit_decision_breaker_and_brownout_gates():
+    kw = dict(tenant_inflight=0, quota=4, queue_depth=0,
+              max_queue_depth=8, healthy=True, est_batch_s=0.02)
+    # breaker outranks queue/brownout/quota; health outranks breaker
+    d = admit_decision(**dict(kw, breaker_open=True,
+                              breaker_retry_after_s=0.7, queue_depth=99,
+                              tenant_inflight=99, brownout=True))
+    assert d["reason"] == REJECT_BREAKER
+    assert d["retry_after_s"] == pytest.approx(0.7)  # the honest window
+    d = admit_decision(**dict(kw, breaker_open=True, healthy=False))
+    assert d["reason"] == REJECT_HEALTH
+    # queue outranks brownout
+    d = admit_decision(**dict(kw, queue_depth=8, brownout=True,
+                              tenant_inflight=2))
+    assert d["reason"] == REJECT_QUEUE
+    # brownout sheds over the reduced share, before the quota reason
+    d = admit_decision(**dict(kw, brownout=True, tenant_inflight=2))
+    assert d["reason"] == REJECT_BROWNOUT
+    assert d["retry_after_s"] >= 0.005
+    # ...but never a tenant with nothing in flight (the floor)
+    d = admit_decision(**dict(kw, brownout=True, tenant_inflight=0))
+    assert d["admit"] is True
+    # lowest priority keeps exactly one in flight under brownout
+    d = admit_decision(**dict(kw, brownout=True, tenant_inflight=1,
+                              priority=0))
+    assert d["reason"] == REJECT_BROWNOUT
+    d = admit_decision(**dict(kw, brownout=True, tenant_inflight=0,
+                              priority=0))
+    assert d["admit"] is True
+    # quota still binds without brownout
+    d = admit_decision(**dict(kw, tenant_inflight=4))
+    assert d["reason"] == REJECT_QUOTA
+
+
+# ---------------------------------------------------------------------------
+# FusedBatchError: the structured per-window failure cause (core layer)
+# ---------------------------------------------------------------------------
+
+def test_compute_fused_batch_surfaces_clean_failure(devs):
+    cr = NumberCruncher(devs.subset(2), INC)
+    x = ClArray(np.zeros(1024, np.float32), name="fb")
+    x.partial_read = True
+    try:
+        cr.enqueue_mode = True
+        # first hit lands on the FIRST per-call iteration's lane
+        # preflight: nothing dispatched at all — applied 0, clean
+        FAULTS.arm("driver-submit:times=1")
+        with pytest.raises(FusedBatchError) as ei:
+            cr.cores.compute_fused_batch(["inc"], [x], 800, 1024, 64, 8)
+        e = ei.value
+        assert e.clean is True
+        assert e.applied_iters == 0 and e.requested_iters == 8
+        assert e.cause == "injected:driver-submit"
+        assert isinstance(e.original, InjectedFaultError)
+        FAULTS.disarm()
+        cr.cores.barrier()
+        cr.cores.flush()
+        np.testing.assert_array_equal(np.asarray(x), 0.0)
+        # skip past every per-call preflight hit (2 lanes × up to 2
+        # per-call iterations): the next fire lands on the fused
+        # FLUSH preflight — the residue after the applied per-call
+        # iterations is still CLEAN (no lane was handed the ladder)
+        FAULTS.arm("driver-submit:after=4,times=1")
+        with pytest.raises(FusedBatchError) as ei:
+            cr.cores.compute_fused_batch(["inc"], [x], 800, 1024, 64, 8)
+        e = ei.value
+        assert e.clean is True
+        assert e.applied_iters == 2 and e.requested_iters == 8
+        FAULTS.disarm()
+        # the applied count is bit-exact: finishing the window shows
+        # exactly the applied per-call iterations
+        cr.cores.barrier()
+        cr.cores.flush()
+        np.testing.assert_array_equal(np.asarray(x), 2.0)
+    finally:
+        FAULTS.disarm()
+        cr.dispose()
+
+
+# ---------------------------------------------------------------------------
+# blast-radius containment end-to-end
+# ---------------------------------------------------------------------------
+
+def test_containment_recovers_transient_fault_bit_exact(devs):
+    """A transient driver-submit fault mid-batch: containment bisects,
+    the residue re-dispatches, and EVERY request completes bit-exactly
+    — the fault is invisible to the callers."""
+    cr, x, job, fe = _mk(devs)
+    try:
+        futs = [fe.submit("tA", job) for _ in range(8)]
+        FAULTS.arm("driver-submit:times=1")
+        out = fe.step()
+        assert out["requests"] == 8 and out["failed"] == 0
+        recs = [f.result(timeout=30) for f in futs]
+        assert len(recs) == 8
+        np.testing.assert_array_equal(np.asarray(x), 8.0)
+        evs = [e for e in __import__(
+            "cekirdekler_tpu.obs.flight", fromlist=["FLIGHT"]
+        ).FLIGHT.snapshot() if e.kind == "serve-contain"]
+        assert any(e.fields.get("outcome") == "bisect" for e in evs)
+    finally:
+        FAULTS.disarm()
+        fe.close()
+        cr.dispose()
+
+
+def test_containment_isolates_exactly_the_faulty_request(devs):
+    """A persistent-enough fault with retries disabled: bisection
+    isolates EXACTLY one request, which fails with the named injected
+    cause; its 7 coalesced neighbors complete bit-identically."""
+    cr, x, job, fe = _mk(
+        devs, resilience=ResilienceConfig(retry_max_attempts=0))
+    try:
+        futs = [fe.submit("tA", job) for _ in range(8)]
+        # fires on: batch(8), part(4), part(2), part(1) — the fourth
+        # hit lands on a single isolated request
+        FAULTS.arm("serve-dispatch:times=4")
+        out = fe.step()
+        assert out["requests"] == 8 and out["failed"] == 1
+        done = [f for f in futs if f.exception(timeout=30) is None]
+        failed = [f for f in futs if f.exception(timeout=30) is not None]
+        assert len(done) == 7 and len(failed) == 1
+        err = failed[0].exception()
+        assert isinstance(err, InjectedFaultError)
+        assert err.point == "serve-dispatch"
+        # bit-exact: exactly the 7 surviving requests applied
+        np.testing.assert_array_equal(np.asarray(x), 7.0)
+        assert REGISTRY.counter(
+            "ck_serve_contained_total",
+            "fused-batch failures handled by blast-radius containment",
+            outcome="isolated").value >= 1
+    finally:
+        FAULTS.disarm()
+        fe.close()
+        cr.dispose()
+
+
+def test_retry_budget_contains_flaky_faults_p_mode(devs):
+    """The satellite's p= flaky mode: a seeded probabilistic
+    serve-dispatch fault; the retry budget re-dispatches isolated
+    failures and the workload stays bit-exact (completed == array,
+    failures named)."""
+    cr, x, job, fe = _mk(devs)
+    m_retries = REGISTRY.counter(
+        "ck_serve_retries_total",
+        "serve request re-dispatch attempts granted by the retry budget")
+    r0 = m_retries.value
+    try:
+        futs = [fe.submit("tA", job) for _ in range(12)]
+        FAULTS.arm("seed=2;serve-dispatch:p=0.6,times=12")
+        fe.step()
+        fired = FAULTS.snapshot()["clauses"][0]["fired"]
+        FAULTS.disarm()
+        assert fired > 0, "the flaky clause never fired"
+        ok = sum(1 for f in futs if f.exception(timeout=30) is None)
+        bad = [f.exception() for f in futs
+               if f.exception(timeout=30) is not None]
+        assert ok + len(bad) == 12
+        assert all(isinstance(e, CekirdeklerError) for e in bad)
+        np.testing.assert_array_equal(np.asarray(x), float(ok))
+        # the budget granted re-dispatches (seeded draws — this plan's
+        # fault sequence is deterministic, and seed=2 lands several
+        # single-request failures that retry to success)
+        assert m_retries.value > r0
+    finally:
+        FAULTS.disarm()
+        fe.close()
+        cr.dispose()
+
+
+def test_containment_decisions_replay_and_tamper(devs):
+    """breaker/retry/containment decisions recorded by a contained run
+    replay bit-identically; a tampered output names its seq."""
+    cr, x, job, fe = _mk(
+        devs, resilience=ResilienceConfig(
+            retry_max_attempts=0, breaker_threshold=1,
+            breaker_open_s=0.05))
+    DECISIONS.clear()
+    try:
+        futs = [fe.submit("tA", job) for _ in range(4)]
+        FAULTS.arm("serve-dispatch:times=3")
+        fe.step()
+        FAULTS.disarm()
+        assert sum(1 for f in futs
+                   if f.exception(timeout=30) is not None) == 1
+        rows = [r.to_row() for r in DECISIONS.snapshot()
+                if r.kind in ("breaker", "retry", "containment", "shed")]
+        kinds = {r["kind"] for r in rows}
+        assert "containment" in kinds and "retry" in kinds \
+            and "breaker" in kinds
+        verdict = verify_records(rows)
+        assert verdict["ok"] is True, verdict
+        assert verdict["replayed"] == len(rows)
+        bad = json.loads(json.dumps(
+            next(r for r in rows if r["kind"] == "breaker")))
+        bad["outputs"]["state"]["failures"] += 1
+        v2 = verify_records([bad])
+        assert v2["ok"] is False
+        assert v2["first_divergence"]["seq"] == bad["seq"]
+    finally:
+        FAULTS.disarm()
+        fe.close()
+        cr.dispose()
+
+
+# ---------------------------------------------------------------------------
+# circuit breaker end-to-end
+# ---------------------------------------------------------------------------
+
+def test_breaker_opens_rejects_probes_and_recovers(devs):
+    cr, x, job, fe = _mk(
+        devs, resilience=ResilienceConfig(
+            retry_max_attempts=0, breaker_threshold=2,
+            breaker_open_s=0.2))
+    try:
+        # two failed requests open the (tenant, signature) breaker
+        futs = [fe.submit("tB", job) for _ in range(2)]
+        FAULTS.arm("serve-dispatch:times=8")
+        fe.step()
+        FAULTS.disarm()
+        assert all(isinstance(f.exception(timeout=30),
+                              InjectedFaultError) for f in futs)
+        with pytest.raises(ServeRejected) as ei:
+            fe.submit("tB", job)
+        assert ei.value.reason == REJECT_BREAKER
+        assert 0.0 < ei.value.retry_after_s <= 0.2
+        # a different tenant's breaker is untouched
+        f_ok = fe.submit("tC", job)
+        fe.step()
+        assert f_ok.exception(timeout=30) is None
+        # after the open window: the next submit is the half-open
+        # probe; its success closes the breaker
+        time.sleep(0.25)
+        f_probe = fe.submit("tB", job)
+        fe.step()
+        assert f_probe.exception(timeout=30) is None
+        f2 = fe.submit("tB", job)  # closed again: admits freely
+        fe.step()
+        assert f2.exception(timeout=30) is None
+    finally:
+        FAULTS.disarm()
+        fe.close()
+        cr.dispose()
+
+
+# ---------------------------------------------------------------------------
+# brownout shedding end-to-end
+# ---------------------------------------------------------------------------
+
+def test_brownout_sheds_over_quota_but_never_starves(devs):
+    cr, x, job, fe = _mk(
+        devs,
+        admission=AdmissionController(max_queue_depth=8, default_quota=4),
+        resilience=ResilienceConfig(brownout_engage_streak=1))
+    fe.admission.set_quota("low", TenantQuota(max_inflight=4, priority=0))
+    try:
+        # queue at the watermark (6 of 8): one evaluation engages
+        # (engage_streak=1)
+        futs = [fe.submit(t, job) for t in ("tA", "tA", "tA",
+                                            "tB", "tB", "tB")]
+        out = fe._evaluate_brownout()
+        assert out["active"] is True
+        # over the brownout share (quota 4 -> shed_quota 2): shed, named
+        with pytest.raises(ServeRejected) as ei:
+            fe.submit("tA", job)
+        assert ei.value.reason == REJECT_BROWNOUT
+        assert ei.value.retry_after_s >= 0.005
+        # a tenant with NOTHING in flight still gets one in (the floor)
+        f_new = fe.submit("tFresh", job)
+        fe.step()
+        for f in futs + [f_new]:
+            assert f.exception(timeout=30) is None
+        # the queue drained but brownout stays engaged until an
+        # all-clear EVALUATION (hysteresis, not instant)
+        assert fe._brownout_active is True
+        # lowest priority keeps exactly one in flight: the second sheds
+        f_low = fe.submit("low", job)
+        with pytest.raises(ServeRejected) as ei:
+            fe.submit("low", job)
+        assert ei.value.reason == REJECT_BROWNOUT
+        fe.step()
+        assert f_low.exception(timeout=30) is None
+        # all-clear evaluation releases the brownout
+        fe._evaluate_brownout()
+        assert fe._brownout_active is False
+        fe.submit("tA", job)
+        fe.step()
+    finally:
+        fe.close()
+        cr.dispose()
+
+
+def test_brownout_releases_while_idle(devs):
+    """Brownout release must not wait for traffic: with the dispatcher
+    idle (no pending requests → no cycles), the loop itself runs the
+    release evaluation — an engaged brownout over an idle tier would
+    otherwise shed the FIRST burst after hours of idleness."""
+    cr, x, job, fe = _mk(
+        devs,
+        admission=AdmissionController(max_queue_depth=8, default_quota=4),
+        resilience=ResilienceConfig(brownout_engage_streak=1))
+    try:
+        futs = [fe.submit(t, job) for t in ("tA", "tA", "tA",
+                                            "tB", "tB", "tB")]
+        assert fe._evaluate_brownout()["active"] is True
+        fe.step()
+        for f in futs:
+            assert f.exception(timeout=30) is None
+        assert fe._brownout_active is True  # queue drained, still engaged
+        fe.start()  # dispatcher idles (nothing pending) — and releases
+        deadline = time.perf_counter() + 5.0
+        while fe._brownout_active and time.perf_counter() < deadline:
+            time.sleep(0.02)
+        assert fe._brownout_active is False
+    finally:
+        fe.close()
+        cr.dispose()
+
+
+def test_cancelled_future_cannot_kill_the_cycle(devs):
+    """A client legally cancels its queued future; the dispatch cycle
+    must settle everyone else and survive (one tenant's cancel must
+    never become a tier-wide dispatcher death)."""
+    cr, x, job, fe = _mk(devs)
+    try:
+        futs = [fe.submit("tA", job) for _ in range(4)]
+        assert futs[1].cancel() is True
+        out = fe.step()
+        assert out["requests"] == 4
+        for i, f in enumerate(futs):
+            if i == 1:
+                assert f.cancelled()
+            else:
+                assert f.exception(timeout=30) is None
+        # the cancelled request's ITERATION still ran (it was popped
+        # with the batch) — the cancel settles the future, not the work
+        np.testing.assert_array_equal(np.asarray(x), 4.0)
+        assert fe.stats()["resilience"]["dead"] is None
+    finally:
+        fe.close()
+        cr.dispose()
+
+
+def test_retry_past_inline_budget_requeues_to_next_cycle(devs):
+    """Backoff past the cycle's inline-sleep budget must re-queue the
+    request instead of stalling the dispatcher; the next cycle
+    re-dispatches it to completion."""
+    cr, x, job, fe = _mk(
+        devs, resilience=ResilienceConfig(
+            retry_max_attempts=4, retry_base_s=0.02, retry_cap_s=0.1,
+            retry_inline_budget_s=0.0))  # every granted retry defers
+    try:
+        futs = [fe.submit("tA", job) for _ in range(4)]
+        FAULTS.arm("serve-dispatch:times=3")  # batch, 2x bisect parts
+        out = fe.step()
+        FAULTS.disarm()
+        assert out["requeued"] >= 1
+        # the deferred request is back in the table, still in flight
+        assert fe._pending >= 1
+        out2 = fe.step()
+        assert out2["requeued"] == 0
+        for f in futs:
+            assert f.exception(timeout=30) is None
+        np.testing.assert_array_equal(np.asarray(x), 4.0)
+    finally:
+        FAULTS.disarm()
+        fe.close()
+        cr.dispose()
+
+
+def test_cycle_crash_settles_popped_requests_named(devs):
+    """An exception escaping the cycle AFTER requests were popped out
+    of the group table must still settle every popped future with the
+    named error — popped requests are in neither the table nor a
+    result, and used to hang forever."""
+    cr, x, job, fe = _mk(devs)
+    real_note_done = fe.tenants.note_done
+    try:
+        futs = [fe.submit("tA", job) for _ in range(3)]
+
+        def boom(*a, **kw):
+            raise RuntimeError("resolution boom")
+
+        fe.tenants.note_done = boom
+        with pytest.raises(RuntimeError, match="resolution boom"):
+            fe.step()
+        for f in futs:
+            exc = f.exception(timeout=10)
+            assert isinstance(exc, CekirdeklerError)
+            assert "dispatch cycle failed" in str(exc)
+    finally:
+        fe.tenants.note_done = real_note_done
+        fe.close(drain=False)
+        cr.dispose()
+
+
+# ---------------------------------------------------------------------------
+# dispatcher crash containment (satellite 1)
+# ---------------------------------------------------------------------------
+
+def test_dispatcher_crash_fails_futures_and_rejects_submits(
+        devs, tmp_path, monkeypatch):
+    monkeypatch.setenv("CK_POSTMORTEM_DIR", str(tmp_path))
+    cr = NumberCruncher(devs.subset(2), INC)
+    x = ClArray(np.zeros(512, np.float32), name="cx")
+    x.partial_read = True
+    job = ServeJob(params=[x], kernels=["inc"], compute_id=801,
+                   global_range=512, local_range=64)
+    fe = ServeFrontend(cr, name="crash")  # autostart: the real thread
+    m_crashes = REGISTRY.counter(
+        "ck_serve_dispatcher_crashes_total",
+        "serve dispatcher threads lost to an escaping exception "
+        "(in-flight futures failed with the named error)")
+    c0 = m_crashes.value
+    try:
+        fe.step = lambda: (_ for _ in ()).throw(RuntimeError("boom"))
+        fut = fe.submit("tA", job)
+        # the in-flight future fails with the NAMED error — no hang
+        with pytest.raises(CekirdeklerError, match="dispatcher died"):
+            fut.result(timeout=10)
+        # submit after death rejects immediately, also named
+        with pytest.raises(CekirdeklerError, match="dispatcher died"):
+            fe.submit("tA", job)
+        assert m_crashes.value == c0 + 1
+        assert fe.stats()["resilience"]["dead"] is not None
+        # the black box dumped (CK_POSTMORTEM_DIR armed)
+        assert any(f.startswith("ck_postmortem")
+                   for f in os.listdir(tmp_path))
+    finally:
+        fe.close(drain=False)
+        cr.dispose()
+
+
+# ---------------------------------------------------------------------------
+# shutdown racing an in-flight retry/bisection (satellite 3)
+# ---------------------------------------------------------------------------
+
+def test_close_races_inflight_retry_16_threads_no_dispatch_after_halt(
+        devs):
+    """16 submitting threads, every dispatch failing (so the cycle is
+    mid-retry/bisection when close lands): every future resolves
+    (result or NAMED error, never a hang), and no dispatch follows the
+    halt."""
+    cr, x, job, fe = _mk(
+        devs, resilience=ResilienceConfig(
+            retry_max_attempts=2, retry_base_s=0.02, retry_cap_s=0.08))
+    dispatches = [0]
+    last_dispatch_t = [0.0]
+    halt_t = [None]
+    real = cr.cores.compute_fused_batch
+
+    def counting(*a, **kw):
+        dispatches[0] += 1
+        last_dispatch_t[0] = time.perf_counter()
+        return real(*a, **kw)
+
+    cr.cores.compute_fused_batch = counting
+    futs = []
+    mu = threading.Lock()
+
+    def client():
+        try:
+            f = fe.submit("tA", job)
+            with mu:
+                futs.append(f)
+        except CekirdeklerError:
+            pass  # closed-race rejections are fine (named)
+
+    try:
+        FAULTS.arm("serve-dispatch:times=1000")
+        threads = [threading.Thread(target=client) for _ in range(16)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(10)
+        stepper = threading.Thread(target=lambda: fe.step())
+        stepper.start()
+        time.sleep(0.05)  # let the cycle get into retry/bisection
+        fe.close(drain=False)
+        halt_t[0] = time.perf_counter()
+        stepper.join(30)
+        assert not stepper.is_alive()
+        # every future resolved, each with a NAMED framework error
+        # (injected fault, shutdown, or a successful early part)
+        for f in futs:
+            exc = f.exception(timeout=10)
+            if exc is not None:
+                assert isinstance(exc, CekirdeklerError), exc
+        # no dispatch after the halt: the containment loop checks the
+        # halt flag before every part
+        n_at_close = dispatches[0]
+        time.sleep(0.2)
+        assert dispatches[0] == n_at_close
+        assert last_dispatch_t[0] <= halt_t[0]
+    finally:
+        FAULTS.disarm()
+        cr.cores.compute_fused_batch = real
+        fe.close(drain=False)
+        cr.dispose()
+
+
+# ---------------------------------------------------------------------------
+# the chaos drill (the acceptance criterion)
+# ---------------------------------------------------------------------------
+
+def _load_loadgen():
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "ck_loadgen_chaos_test", os.path.join(here, "tools", "loadgen.py"))
+    lg = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(lg)
+    return lg
+
+
+def test_chaos_drill_32_clients_goodput_floor(devs):
+    """The ISSUE 15 acceptance drill: a seeded CK_FAULTS plan
+    (driver-submit failures + lane stall + slow link) under a 32-client
+    mixed-tenant coalesced workload — zero hung futures, bit-exact
+    results, named failures only, and >= 0.5 goodput retained vs the
+    fault-free control."""
+    lg = _load_loadgen()
+    out = lg.run_chaos(devs, clients=32, tenants=4, signatures=4,
+                       requests_per_client=4, n=4096)
+    brief = {k: v for k, v in out["chaos"].items()
+             if k not in ("closed",)}
+    assert out["hangs"] == 0, brief
+    assert out["unnamed_failures"] == 0, brief
+    assert out["chaos"]["checked"] is True, brief  # bit-exact under faults
+    assert out["control"]["checked"] is True
+    assert out["goodput_frac"] is not None
+    assert out["goodput_frac"] >= 0.5, out
+    assert out["checked"] is True, {
+        k: out[k] for k in ("goodput_frac", "hangs", "unnamed_failures")}

@@ -63,6 +63,11 @@ HOT_ROOTS = (
     # sight, and only the allowlisted frontend/table locks may be taken
     "serve.frontend.ServeFrontend.submit",
     "serve.admission.AdmissionController.check",
+    # the circuit-breaker check on the submit path (ISSUE 15): one
+    # board-lock dict hit for breakerless keys; transitions use
+    # handles cached at board construction and record decisions only
+    # behind DECISIONS.enabled
+    "serve.resilience.BreakerBoard.admit",
     # the fault-injection plane (ISSUE 13): fire() is reached from the
     # driver-queue submit path — every instrumented site guards with
     # `if FAULTS.enabled:` and the per-point metric handles are cached
@@ -93,6 +98,10 @@ HOT_LOCK_ALLOW = (
     # fault plane: taken ONLY when an armed clause matches the point —
     # test/chaos rigs; the disabled fast path never reaches it
     "utils.faultinject.FaultPlane._mu",
+    # breaker board: one uncontended acquisition per submit (a dict
+    # miss for keys with no breaker state), nested inside the frontend
+    # condition — the documented budget
+    "serve.resilience.BreakerBoard._mu",
 )
 
 

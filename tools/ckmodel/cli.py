@@ -118,8 +118,8 @@ def main(argv=None) -> int:
                     "controller state machines "
                     "(docs/STATIC_ANALYSIS.md)")
     ap.add_argument("--machine", choices=("drain", "elastic", "serve",
-                                          "balance"),
-                    help="check one machine (default: all four + the "
+                                          "balance", "resilience"),
+                    help="check one machine (default: all five + the "
                          "purity lint)")
     ap.add_argument("--depth", type=int, default=None,
                     help="bound scale (default 1 = tier-1; env "

@@ -49,6 +49,11 @@ PURE_FUNCTIONS = (
      ("drain_transition", "apply_quarantine"), ()),
     ("cekirdekler_tpu/serve/admission.py", ("admit_decision",), ()),
     ("cekirdekler_tpu/serve/coalescer.py", ("plan_coalesce",), ()),
+    # the serving resilience layer (breaker/shed/retry/containment):
+    # every one takes its clock/jitter reading as an ARGUMENT
+    ("cekirdekler_tpu/serve/resilience.py",
+     ("breaker_transition", "breaker_admit", "brownout_transition",
+      "retry_decision", "containment_plan"), ()),
     ("cekirdekler_tpu/obs/health.py", ("evaluate_window",), ()),
     # member_resplit delegates to the cluster balancer's pure LCM math
     # (one re-split implementation — the PR 12 rule)

@@ -80,6 +80,16 @@ def _percentile(sorted_vals: list, q: float) -> float:
     return float(sorted_vals[k])
 
 
+#: The default seeded chaos plan (``--mode chaos``; docs/RESILIENCE.md
+#: "Serving resilience"): bounded driver-submit failures (exercises
+#: blast-radius containment + retry budgets), one lane stalling at
+#: barriers, and one slow link — the three failure shapes the serving
+#: tier must survive with goodput intact.
+CHAOS_PLAN = ("seed=42;driver-submit:after=2,times=3;"
+              "lane-stall@lane1:delay_ms=25,times=3;"
+              "slow-link@lane1:factor=3,times=10")
+
+
 def run_loadgen(
     devices=None,
     clients: int = 32,
@@ -95,14 +105,19 @@ def run_loadgen(
     quota: int = 0,
     max_queue_depth: int = 0,
     max_retries: int = 50,
+    resilience=None,
 ) -> dict:
     """One load-generator run (see module docstring).  Returns the
     result dict with p50/p99 latency, goodput, the coalescing evidence,
-    and the exactness check."""
+    and the exactness check.  Under an armed fault plan the result also
+    carries the chaos evidence: ``hangs`` (futures that never resolved
+    — must be 0), ``unnamed_failures`` (failures without a framework-
+    named cause — must be 0), and ``failure_causes``."""
     import numpy as np
 
     from cekirdekler_tpu import ClArray
     from cekirdekler_tpu.core.cruncher import NumberCruncher
+    from cekirdekler_tpu.errors import CekirdeklerError
     from cekirdekler_tpu.hardware import all_devices
     from cekirdekler_tpu.metrics.registry import REGISTRY
     from cekirdekler_tpu.serve import (
@@ -141,6 +156,7 @@ def run_loadgen(
     fe = ServeFrontend(
         cr, admission=admission, max_batch=max_batch,
         gather_window_s=gather_window_s, name=f"loadgen-{mode}",
+        resilience=resilience,
     )
 
     m_windows = REGISTRY.counter(
@@ -154,6 +170,9 @@ def run_loadgen(
     rejected = [0]
     retries_exhausted = [0]
     failed = [0]
+    hangs = [0]
+    unnamed = [0]
+    failure_causes: dict = {}
     mu = threading.Lock()
 
     def submit_with_retry(tenant: str, job: ServeJob):
@@ -170,12 +189,24 @@ def run_loadgen(
             retries_exhausted[0] += 1
         return None
 
+    from concurrent.futures import TimeoutError as _FutTimeout
+
     def note_done(fut, sig_idx: int):
         try:
             r = fut.result(timeout=60.0)
-        except Exception:  # noqa: BLE001 - counted, checked below
+        except (TimeoutError, _FutTimeout):
+            # the one outcome chaos must NEVER produce: a future that
+            # does not resolve (counted separately from failures)
+            with mu:
+                hangs[0] += 1
+            return
+        except Exception as e:  # noqa: BLE001 - counted, checked below
             with mu:
                 failed[0] += 1
+                cause = type(e).__name__
+                failure_causes[cause] = failure_causes.get(cause, 0) + 1
+                if not isinstance(e, CekirdeklerError):
+                    unnamed[0] += 1
             return
         with mu:
             latencies.append(r["latency_s"])
@@ -240,6 +271,9 @@ def run_loadgen(
         "requests_target": total_target,
         "completed": completed,
         "failed": failed[0],
+        "hangs": hangs[0],
+        "unnamed_failures": unnamed[0],
+        "failure_causes": dict(sorted(failure_causes.items())),
         "rejected": rejected[0],
         "retries_exhausted": retries_exhausted[0],
         "wall_s": round(wall_s, 4),
@@ -260,12 +294,79 @@ def run_loadgen(
     }
 
 
+def run_chaos(devices=None, clients: int = 32, tenants: int = 4,
+              signatures: int = 4, requests_per_client: int = 4,
+              plan: str = CHAOS_PLAN, n: int = 1 << 13,
+              goodput_floor: float = 0.5) -> dict:
+    """The chaos acceptance drill (docs/RESILIENCE.md, "Serving
+    resilience"): run the closed-loop workload FAULT-FREE (the control),
+    then again under the seeded ``plan`` (driver-submit failures + lane
+    stall + slow link), and check the four chaos contracts:
+
+    - **no hangs** — every submitted future resolves;
+    - **bit-exact** — every signature's array equals its successful
+      count exactly (containment: a faulted request's iterations never
+      half-apply);
+    - **named failures** — every failure carries a framework-named
+      cause (never a bare exception from the middle of a batch);
+    - **goodput retained** — chaos goodput / control goodput clears
+      ``goodput_floor``.
+
+    ``checked`` is the conjunction; the bench's ``serving`` section
+    mints ``serve_chaos_goodput_frac`` / ``serve_chaos_p99_ms`` from
+    this (tools/regress.py watches both)."""
+    from cekirdekler_tpu.utils.faultinject import FAULTS
+
+    # untimed warmup: the ladder compiles are process-global, so
+    # without this the control run pays them and the chaos run does
+    # not — goodput_frac would measure compile warmth, not resilience
+    run_loadgen(devices, clients=4, tenants=tenants,
+                signatures=signatures, requests_per_client=1,
+                mode="closed", n=n)
+    control = run_loadgen(
+        devices, clients=clients, tenants=tenants,
+        signatures=signatures, requests_per_client=requests_per_client,
+        mode="closed", n=n)
+    FAULTS.arm(plan)
+    try:
+        chaos = run_loadgen(
+            devices, clients=clients, tenants=tenants,
+            signatures=signatures,
+            requests_per_client=requests_per_client, mode="closed", n=n)
+    finally:
+        FAULTS.disarm()
+    frac = None
+    if control.get("goodput_rps") and chaos.get("goodput_rps"):
+        frac = round(chaos["goodput_rps"] / control["goodput_rps"], 4)
+    checked = bool(
+        control["checked"] and chaos["checked"]
+        and chaos["hangs"] == 0 and chaos["unnamed_failures"] == 0
+        and frac is not None and frac >= float(goodput_floor))
+    return {
+        "plan": plan,
+        "goodput_frac": frac,
+        "goodput_floor": goodput_floor,
+        "chaos_p99_ms": chaos["p99_ms"],
+        "hangs": chaos["hangs"],
+        "failed": chaos["failed"],
+        "unnamed_failures": chaos["unnamed_failures"],
+        "failure_causes": chaos["failure_causes"],
+        "checked": checked,
+        "control": control,
+        "chaos": chaos,
+    }
+
+
 def loadgen_section(devices=None, clients: int = 32, tenants: int = 4,
                     signatures: int = 4, requests_per_client: int = 8,
                     rate_rps: float = 400.0) -> dict:
     """bench.py's ``serving`` section: one closed-loop run (the latency
-    keys) + one open-loop run (the goodput key), with the headline
-    floats hoisted to the top level."""
+    keys) + one open-loop run (the goodput key) + one chaos sub-run
+    (the resilience keys), with the headline floats hoisted to the top
+    level.  The chaos keys are exactness-gated: any chaos-contract
+    violation (hang, inexact array, unnamed failure, goodput below the
+    floor) makes them None — the regression sentinel reads that as
+    STARVED, never as a pass."""
     closed = run_loadgen(
         devices, clients=clients, tenants=tenants, signatures=signatures,
         requests_per_client=requests_per_client, mode="closed")
@@ -273,15 +374,25 @@ def loadgen_section(devices=None, clients: int = 32, tenants: int = 4,
         devices, clients=clients, tenants=tenants, signatures=signatures,
         requests_per_client=requests_per_client, mode="open",
         rate_rps=rate_rps)
+    chaos = run_chaos(
+        devices, clients=clients, tenants=tenants, signatures=signatures,
+        requests_per_client=max(2, requests_per_client // 2))
     return {
         "p50_ms": closed["p50_ms"],
         "p99_ms": closed["p99_ms"],
         "goodput_rps": opened["goodput_rps"],
         "coalesce_ratio": closed["coalesce_ratio"],
+        "chaos_goodput_frac": (chaos["goodput_frac"]
+                               if chaos["checked"] else None),
+        "chaos_p99_ms": (chaos["chaos_p99_ms"]
+                         if chaos["checked"] else None),
         "coalesced": bool(closed["coalesced"] and opened["coalesced"]),
-        "checked": bool(closed["checked"] and opened["checked"]),
+        "checked": bool(closed["checked"] and opened["checked"]
+                        and chaos["checked"]),
         "closed": closed,
         "open": opened,
+        "chaos": {k: v for k, v in chaos.items()
+                  if k not in ("control", "chaos")},
     }
 
 
@@ -294,8 +405,10 @@ def main(argv=None) -> int:
     ap.add_argument("--signatures", type=int, default=4)
     ap.add_argument("--requests", type=int, default=8,
                     help="requests per client")
-    ap.add_argument("--mode", choices=("closed", "open", "both"),
+    ap.add_argument("--mode", choices=("closed", "open", "both", "chaos"),
                     default="closed")
+    ap.add_argument("--plan", default=CHAOS_PLAN,
+                    help="chaos mode: the seeded CK_FAULTS plan string")
     ap.add_argument("--rate", type=float, default=200.0,
                     help="open-loop aggregate submit rate (rps)")
     ap.add_argument("--n", type=int, default=1 << 14,
@@ -310,6 +423,11 @@ def main(argv=None) -> int:
             clients=args.clients, tenants=args.tenants,
             signatures=args.signatures, requests_per_client=args.requests,
             rate_rps=args.rate)
+    elif args.mode == "chaos":
+        out = run_chaos(
+            clients=args.clients, tenants=args.tenants,
+            signatures=args.signatures, requests_per_client=args.requests,
+            plan=args.plan, n=args.n)
     else:
         out = run_loadgen(
             clients=args.clients, tenants=args.tenants,
@@ -318,8 +436,10 @@ def main(argv=None) -> int:
     if args.json:
         print(json.dumps(_json_safe(out), allow_nan=False))
         return 0
-    rows = out if args.mode != "both" else {
-        k: v for k, v in out.items() if k not in ("closed", "open")}
+    rows = {
+        k: v for k, v in out.items()
+        if k not in ("closed", "open", "control", "chaos")
+    } if args.mode in ("both", "chaos") else out
     for k, v in rows.items():
         print(f"  {k:>20}: {v}")
     if not out.get("checked", True):

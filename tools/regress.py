@@ -100,6 +100,14 @@ WATCHED_KEYS = (
     ("serve_p99_ms", (), "lower", 0.40),
     ("serve_goodput_rps", (), "higher", 0.25),
     ("serve_coalesce_ratio", (), "higher", 0.20),
+    # serving resilience (ISSUE 15, the chaos sub-run inside the
+    # "serving" section): goodput retained under the seeded fault plan
+    # vs the fault-free control (higher is better; exactness-gated to
+    # None on any chaos-contract violation), and the chaos run's p99
+    # (lower is better).  Floors are wide: both ride injected
+    # sleep-scale faults on a contended CPU container
+    ("serve_chaos_goodput_frac", (), "higher", 0.30),
+    ("serve_chaos_p99_ms", (), "lower", 0.50),
     # recovery tier (ISSUE 13, bench section "resilience"): wall from an
     # injected degradation's first barrier to the drain taking effect
     # (lower is better), and windows for a kill-resume run to reconverge
@@ -129,6 +137,8 @@ KEY_SECTION = {
     "serve_p99_ms": "serving",
     "serve_goodput_rps": "serving",
     "serve_coalesce_ratio": "serving",
+    "serve_chaos_goodput_frac": "serving",
+    "serve_chaos_p99_ms": "serving",
     "drain_recover_ms": "resilience",
     "rejoin_converge_iters": "resilience",
 }
