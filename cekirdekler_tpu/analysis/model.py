@@ -73,6 +73,7 @@ __all__ = [
     "BreakerMachine",
     "ShedMachine",
     "RetryMachine",
+    "BlockMachine",
     "MACHINE_NAMES",
     "build_machines",
     "check_machine",
@@ -84,8 +85,10 @@ __all__ = [
 #: CLI/bench machine vocabulary: ``serve`` groups the admission and
 #: coalesce sub-machines (one serving tier, two pure planners);
 #: ``resilience`` groups the breaker, brownout-shed and retry-budget
-#: machines (``serve/resilience.py``).
-MACHINE_NAMES = ("drain", "elastic", "serve", "balance", "resilience")
+#: machines (``serve/resilience.py``); ``block`` explores the tile
+#: autotuner's choice transition (``core/blocktuner.py``).
+MACHINE_NAMES = ("drain", "elastic", "serve", "balance", "resilience",
+                 "block")
 
 #: Deepen-on-the-bench-rig knob: a positive integer scales the bounds
 #: (balancer horizon, starvation caps, rate alphabet) beyond tier-1.
@@ -1696,6 +1699,148 @@ class RetryMachine(MachineBase):
         return bad
 
 
+class BlockMachine(MachineBase):
+    """Every reachable (engaged choice × measured-wall set) point of
+    the block autotuner's pure transition
+    (:func:`~..core.blocktuner.block_transition`), walls drawn from a
+    small quantized level alphabet that straddles the hysteresis
+    fraction (1.05/1.00 sits inside the 8% band, 2.00 far outside) —
+    proves the engaged choice is always a legal tile, noise can never
+    flap it, and no choice change goes unrecorded.
+
+    Seams: ``decide`` (default: the real ``block_transition``) and
+    ``emit`` (default: identity — the row a change would record).  The
+    broken fixtures in tests/test_ckmodel.py replace each to prove the
+    checker catches an illegal chooser, a hysteresis-free chooser, and
+    a silent retune."""
+
+    name = "block/choice"
+    checks = ("choice-legality", "hysteresis-bound", "retune-visibility")
+
+    def __init__(self, tq: int = 256, tk: int = 256,
+                 wall_levels=(1.0, 1.05, 2.0), max_measured: int = 2,
+                 decide=None, emit=None):
+        from ..core import blocktuner as BT
+
+        self.invariants = BT.MODEL_INVARIANTS
+        super().__init__()
+        self.BT = BT
+        self.tq, self.tk = int(tq), int(tk)
+        self.grid = BT.legal_block_grid(self.tq, self.tk)
+        self.wall_levels = tuple(float(w) for w in wall_levels)
+        self.max_measured = int(max_measured)
+        self.decide = decide or BT.block_transition
+        self.emit = emit if emit is not None else (lambda row: [row])
+
+    def initial_states(self):
+        return [(None, ())]  # unengaged, nothing measured
+
+    def state_doc(self, state):
+        current, walls = state
+        return {"current": current,
+                "walls": [[list(p), self.wall_levels[i]]
+                          for p, i in walls],
+                "grid": [list(p) for p in self.grid]}
+
+    def _wall_list(self, walls):
+        return [(p, self.wall_levels[i]) for p, i in walls]
+
+    def _decide_at(self, current, walls):
+        return self.decide(current, self._wall_list(walls), self.grid,
+                           hysteresis=self.BT.HYSTERESIS_FRAC)
+
+    def actions(self, state):
+        current, walls = state
+        wd = dict(walls)
+        out = []
+        for pair in self.grid:
+            if len(wd) >= self.max_measured and pair not in wd:
+                continue  # bounded measured set
+            for li in range(len(self.wall_levels)):
+                nwd = dict(wd)
+                nwd[pair] = li
+                nwalls = tuple(sorted(nwd.items()))
+                choice, why = self._decide_at(current, nwalls)
+                changed = choice is not None and choice != (
+                    None if current is None else tuple(current))
+                rows = []
+                if changed:
+                    rows = list(self.emit({
+                        "kind": "block-retune",
+                        "inputs": {
+                            "tq": self.tq, "tk": self.tk,
+                            "grid": [list(p) for p in self.grid],
+                            "walls": [[list(p), w] for p, w in
+                                      self._wall_list(nwalls)],
+                            "current": (None if current is None
+                                        else list(current)),
+                            "seed": None, "fallback": None,
+                            "hysteresis": self.BT.HYSTERESIS_FRAC,
+                        },
+                        "outputs": {"block_q": choice[0],
+                                    "block_k": choice[1], "why": why},
+                    }))
+                nxt = (choice if changed else current, nwalls)
+                out.append(
+                    (f"measure({pair[0]}x{pair[1]}@L{li})", rows, nxt))
+        if current is not None or walls:
+            out.append(("invalidate", [], (None, ())))
+        return out
+
+    def check_action(self, state, label, rows, nxt):
+        if label == "invalidate":
+            return []
+        current, _ = state
+        _ncur, nwalls = nxt
+        # re-derive the edge's decision from the post-measure walls —
+        # deterministic, so the checks see exactly what actions() saw
+        choice, why = self._decide_at(current, nwalls)
+        changed = choice is not None and choice != (
+            None if current is None else tuple(current))
+        bad = []
+        self._hit("choice-legality")
+        if choice is not None and tuple(choice) not in set(self.grid):
+            bad.append((
+                "choice-legality",
+                f"engaged choice {choice} is not in the legal grid "
+                f"for (tq={self.tq}, tk={self.tk})"))
+        if choice is None and why not in ("no-legal-grid", "cold"):
+            bad.append((
+                "choice-legality",
+                f"None choice carries why {why!r} — an unnamed dense "
+                "fallback"))
+        self._hit("hysteresis-bound")
+        if changed and current is not None and why != "measuring":
+            # "measuring" is the one exempt change: the incumbent had
+            # no measured wall, so there is no band to defend
+            wd = dict(self._wall_list(nwalls))
+            cur_w = wd.get(tuple(current))
+            best_w = wd.get(tuple(choice)) if choice is not None else None
+            if cur_w is not None and (
+                    best_w is None
+                    or best_w >= cur_w * (1.0 - self.BT.HYSTERESIS_FRAC)
+                    - 1e-12):
+                bad.append((
+                    "hysteresis-bound",
+                    f"choice moved {current}->{choice} on walls "
+                    f"best={best_w} vs incumbent={cur_w}: inside the "
+                    f"{self.BT.HYSTERESIS_FRAC:.0%} band — noise can "
+                    "flap the choice"))
+        self._hit("retune-visibility")
+        if changed:
+            visible = any(
+                r.get("kind") == "block-retune"
+                and r.get("outputs", {}).get("block_q") == choice[0]
+                and r.get("outputs", {}).get("block_k") == choice[1]
+                for r in rows)
+            if not visible:
+                bad.append((
+                    "retune-visibility",
+                    f"choice changed {current}->{choice} with no "
+                    "matching block-retune row — a silent retune"))
+        return bad
+
+
 # ---------------------------------------------------------------------------
 # assembly, reports, and the counterexample bridge
 # ---------------------------------------------------------------------------
@@ -1758,6 +1903,14 @@ def build_machines(name: str, quick: bool = False,
                 ShedMachine(engage_streak=1 + scale),
                 RetryMachine(max_attempts=1 + scale,
                              budget_cap=1 + scale)]
+    if name == "block":
+        if quick:
+            return [BlockMachine(tq=256, tk=256,
+                                 wall_levels=(1.0, 1.05),
+                                 max_measured=2)]
+        return [BlockMachine(tq=512, tk=512,
+                             wall_levels=(1.0, 1.05, 2.0),
+                             max_measured=2 + min(scale - 1, 1))]
     raise ValueError(
         f"unknown machine {name!r}; machines: {MACHINE_NAMES}")
 

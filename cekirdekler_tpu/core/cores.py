@@ -269,6 +269,8 @@ class Cores:
         self._m_fused_iters = REGISTRY.counter(
             "ck_fused_iters_total",
             "iterations dispatched via fused ladders")
+        self._m_barriers = REGISTRY.counter(
+            "ck_barriers_total", "enqueue-window sync points")
         # ---- streamed partition transfers (the read/compute/write
         # pipeline WITHIN one lane's partition): the plain path's
         # monolithic upload → ladder → download becomes a chunked
@@ -972,7 +974,18 @@ class Cores:
                 "enqueue", t_start, cid=cid,
                 tag="+".join(kernel_names) + " fused-defer",
             )
-        self._record_perf(cid, t_start, self.global_ranges.get(cid, []))
+        if self.performance_feed:
+            # the feed wants a printed row per call — keep the full
+            # record on that (diagnostic) configuration only
+            self._record_perf(cid, t_start, self.global_ranges.get(cid, []))
+        else:
+            # deferral budget is "a counter increment" (r7 attribution:
+            # scheduler_dispatch residue) — building a ComputePerf here
+            # per deferred call costs three list allocations + a deque
+            # append for a row whose device numbers are stale anyway
+            # (the window hasn't dispatched).  One real row lands per
+            # window in _dispatch_fused.
+            self.last_compute_id = cid
         return True
 
     def _dispatch_fused(self, run: _FusedRun, iters: int) -> None:
@@ -1036,6 +1049,11 @@ class Cores:
             self.fused_stats["fused_iters"] += iters
         self._m_fused_windows.inc()
         self._m_fused_iters.inc(iters)
+        # one ComputePerf per dispatched window (total_ms = this
+        # dispatch pass) — the per-window row the per-deferral fast
+        # path above stopped paying for
+        self._record_perf(run.compute_id, _tt,
+                          self.global_ranges.get(run.compute_id, []))
         FLIGHT.event("fused-window", cid=run.compute_id, iters=iters)
         TRACER.record("fused", _tt, cid=run.compute_id, tag=f"x{iters}")
 
@@ -2261,9 +2279,10 @@ class Cores:
         per-iteration benches (balance.per_iteration_benches) so windows
         of different sizes feed the balancer one scale."""
         self._fused_close()
-        REGISTRY.counter(
-            "ck_barriers_total", "enqueue-window sync points",
-        ).inc()
+        # cached handle (constructor): the barrier is every window's
+        # fence — a registry get-or-create per window is window_rtt
+        # residue (r7 attribution)
+        self._m_barriers.inc()
         _mt0 = time.perf_counter()
         t_b = TRACER.t0()
         # ONE consistent snapshot of the window state under the lock:

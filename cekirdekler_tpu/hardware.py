@@ -29,7 +29,50 @@ __all__ = [
     "Platforms",
     "platforms",
     "all_devices",
+    "DEVICE_PEAKS",
+    "device_peaks",
 ]
+
+
+#: Device-kind → (peak dense-matmul Tflop/s at the native narrow dtype,
+#: peak HBM GB/s), keyed on ``jax.Device.device_kind`` strings (public
+#: chip specs).  THE source of roofline/MFU peaks:
+#: ``trace/device.roofline_row`` defaults from here via
+#: :func:`device_peaks` — an MFU printed on a v4 or v6e rig must be
+#: judged against THAT chip's roof, not silently against v5e's (ISSUE
+#: 16 satellite).  Kinds the table doesn't know fall back to the v5e
+#: numbers, NAMED as such in the returned kind.
+DEVICE_PEAKS: dict[str, tuple[float, float]] = {
+    # bf16 peaks for the TPU generations JAX reports by these kinds
+    "TPU v4": (275.0, 1228.0),
+    "TPU v5 lite": (197.0, 819.0),
+    "TPU v5e": (197.0, 819.0),
+    "TPU v5p": (459.0, 2765.0),
+    "TPU v6 lite": (918.0, 1640.0),
+    "TPU v6e": (918.0, 1640.0),
+}
+
+#: The fallback kind (and the historical default): TPU v5e.
+DEFAULT_PEAK_KIND = "TPU v5e"
+
+
+def device_peaks(device_kind: str | None = None) -> tuple[float, float, str]:
+    """``(peak_tflops, peak_gbps, resolved_kind)`` for a device kind.
+    ``None`` resolves the current rig's first device; unknown kinds
+    (including CPU containers) fall back to the v5e numbers with the
+    resolved kind naming the fallback (``"TPU v5e (fallback for X)"``)
+    so a wrong-roof MFU is at least visibly wrong."""
+    kind = device_kind
+    if kind is None:
+        try:
+            kind = str(jax.devices()[0].device_kind)
+        except Exception:  # noqa: BLE001 - no backend: fall back, named
+            kind = "unknown"
+    if kind in DEVICE_PEAKS:
+        tf, gb = DEVICE_PEAKS[kind]
+        return tf, gb, kind
+    tf, gb = DEVICE_PEAKS[DEFAULT_PEAK_KIND]
+    return tf, gb, f"{DEFAULT_PEAK_KIND} (fallback for {kind})"
 
 
 class AcceleratorType(enum.IntFlag):

@@ -116,6 +116,7 @@ DECISION_KINDS = (
     "member-leave",        # cluster/elastic — a member departed, re-split
     "member-join",         # cluster/elastic — a member arrived, re-split
     "checkpoint-restore",  # cluster/elastic — a run resumed from a window ckpt
+    "block-retune",        # core/blocktuner — tile/block choice engaged/moved
 )
 
 #: The subset replay-verify re-executes: decisions that are pure
@@ -127,6 +128,7 @@ REPLAYABLE_KINDS = (
     "admission", "coalesce",
     "breaker", "shed", "retry", "containment",
     "drain-apply", "readmit", "member-leave", "member-join",
+    "block-retune",
 )
 
 #: The complement, DECLARED: every decision kind is placed in exactly

@@ -86,7 +86,7 @@ POSTMORTEM_DIR_ENV = "CK_POSTMORTEM_DIR"
 EVENT_KINDS = (
     "rebalance", "balance-freeze", "balance-jump",
     "fused-engage", "fused-disengage", "fused-window",
-    "stream-choice", "stream-retune",
+    "stream-choice", "stream-retune", "block-retune",
     "barrier", "driver-error", "metrics-sample", "crash",
     "kernel-verify",
     "debug-server", "debug-port-skipped",

@@ -79,6 +79,9 @@ WHATIF_KNOBS = {
     "smoothing": "sliding-window share smoothing (on/off)",
     "overhead_ms": "transfer tuner per-chunk overhead (float; replays "
                    "every transfer-choose with this lane overhead)",
+    "block_grid": "block tuner candidate tile sizes, x-separated (e.g. "
+                  "128x256x512; replays every block-retune with the "
+                  "legal grid rebuilt from these candidates)",
 }
 
 #: Consecutive no-change iterations that close a what-if simulation.
@@ -460,6 +463,34 @@ def _replay_member(inp: dict, out: dict) -> dict:
     return mism
 
 
+def _replay_block_retune(inp: dict, out: dict) -> dict:
+    """Re-run the pure block transition from the recorded snapshot —
+    the tuner's stateful wrapper records exactly the value-copied
+    inputs ``block_transition`` consumed, so the re-derivation is
+    bit-exact by construction (walls are sorted inside the pure fn;
+    insertion order cannot diverge the replay)."""
+    from ..core.blocktuner import HYSTERESIS_FRAC, block_transition
+
+    walls = [(_retuple(p), float(w)) for p, w in (inp.get("walls") or [])]
+    grid = tuple(_retuple(p) for p in (inp.get("grid") or []))
+    choice, why = block_transition(
+        _retuple(inp.get("current")), walls, grid,
+        hysteresis=float(inp.get("hysteresis", HYSTERESIS_FRAC)),
+        seed=_retuple(inp.get("seed")),
+        fallback=_retuple(inp.get("fallback")),
+    )
+    got = {
+        "block_q": None if choice is None else choice[0],
+        "block_k": None if choice is None else choice[1],
+        "why": why,
+    }
+    mism: dict = {}
+    for k, gv in got.items():
+        if gv != out.get(k):
+            mism[k] = {"expected": out.get(k), "got": gv}
+    return mism
+
+
 _REPLAYERS = {
     "load-balance": _replay_load_balance,
     "transfer-choose": _replay_transfer_choose,
@@ -475,6 +506,7 @@ _REPLAYERS = {
     "readmit": _replay_drain,
     "member-leave": _replay_member,
     "member-join": _replay_member,
+    "block-retune": _replay_block_retune,
 }
 assert set(_REPLAYERS) == set(REPLAYABLE_KINDS)
 
@@ -713,7 +745,8 @@ def whatif(records, overrides: dict, cid=None, horizon: int = 200) -> dict:
             f"knobs: {sorted(WHATIF_KNOBS)}")
     if recs:
         balance_overrides = {
-            k: v for k, v in overrides.items() if k != "overhead_ms"}
+            k: v for k, v in overrides.items()
+            if k not in ("overhead_ms", "block_grid")}
         factual = simulate_balance(recs, {}, horizon)
         counter = simulate_balance(recs, balance_overrides, horizon)
         l1 = None
@@ -745,6 +778,47 @@ def whatif(records, overrides: dict, cid=None, horizon: int = 200) -> dict:
                 })
         out["chunk_choices"] = choices
         out["chunk_choices_changed"] = sum(
+            1 for c in choices if c["factual"] != c["counterfactual"])
+    if "block_grid" in overrides:
+        from ..core.blocktuner import (
+            HYSTERESIS_FRAC, block_transition, legal_block_grid)
+
+        raw = overrides["block_grid"]
+        if isinstance(raw, str):
+            cands = tuple(int(s) for s in raw.split("x") if s.strip())
+        elif isinstance(raw, (int, float)):
+            cands = (int(raw),)
+        else:
+            cands = tuple(int(c) for c in raw)
+        choices = []
+        with _quiesced():
+            for r in rows:
+                if r["kind"] != "block-retune":
+                    continue
+                inp = r["inputs"]
+                grid = legal_block_grid(
+                    int(inp["tq"]), int(inp["tk"]), candidates=cands)
+                walls = [(_retuple(p), float(w))
+                         for p, w in (inp.get("walls") or [])]
+                choice, why = block_transition(
+                    _retuple(inp.get("current")), walls, grid,
+                    hysteresis=float(
+                        inp.get("hysteresis", HYSTERESIS_FRAC)),
+                    seed=_retuple(inp.get("seed")),
+                    fallback=_retuple(inp.get("fallback")),
+                )
+                fact = (r["outputs"].get("block_q"),
+                        r["outputs"].get("block_k"))
+                cf = (None, None) if choice is None else choice
+                choices.append({
+                    "seq": r.get("seq"),
+                    "kernel_sig": inp.get("kernel_sig"),
+                    "factual": list(fact),
+                    "counterfactual": list(cf),
+                    "why": why,
+                })
+        out["block_choices"] = choices
+        out["block_choices_changed"] = sum(
             1 for c in choices if c["factual"] != c["counterfactual"])
     return out
 

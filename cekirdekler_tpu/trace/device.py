@@ -720,17 +720,29 @@ def roofline_row(
     flops: float,
     bytes_moved: float,
     device_ms: float,
-    peak_tflops: float = V5E_PEAK_BF16_TFLOPS,
-    peak_gbps: float = V5E_HBM_GBPS,
+    peak_tflops: float | None = None,
+    peak_gbps: float | None = None,
+    device_kind: str | None = None,
 ) -> dict:
     """Place one kernel on the machine roofline (Williams et al., 2009).
 
     ``flops``/``bytes_moved`` are the workload's analytic counts (the
     same numbers the bench's MFU rows use), ``device_ms`` the measured
-    device-busy time.  Returns intensity (flop/byte), attained Tflop/s
-    and GB/s, the roof at this intensity, MFU vs the compute peak, the
-    fraction of the (possibly memory-slanted) roof attained, and which
-    side of the ridge the kernel sits on."""
+    device-busy time.  Peaks default from :func:`hardware.device_peaks`
+    for the current rig's device kind (``device_kind`` names one
+    explicitly; ``peak_tflops``/``peak_gbps`` override outright) — an
+    MFU printed on a non-v5e rig is no longer silently scaled to v5e.
+    Returns intensity (flop/byte), attained Tflop/s and GB/s, the roof
+    at this intensity, MFU vs the compute peak, the fraction of the
+    (possibly memory-slanted) roof attained, and which side of the
+    ridge the kernel sits on."""
+    peak_kind = device_kind
+    if peak_tflops is None or peak_gbps is None:
+        from ..hardware import device_peaks
+
+        tf, gb, peak_kind = device_peaks(device_kind)
+        peak_tflops = tf if peak_tflops is None else peak_tflops
+        peak_gbps = gb if peak_gbps is None else peak_gbps
     device_s = max(device_ms, 1e-9) / 1e3
     intensity = flops / max(bytes_moved, 1e-9)
     attained_tflops = flops / device_s / 1e12
@@ -738,6 +750,7 @@ def roofline_row(
     ridge = peak_tflops * 1e12 / (peak_gbps * 1e9)  # flop/byte
     roof_tflops = min(peak_tflops, intensity * peak_gbps * 1e9 / 1e12)
     return {
+        "peak_kind": peak_kind or "override",
         "flops": flops,
         "bytes": bytes_moved,
         "device_ms": round(device_ms, 3),
@@ -1153,6 +1166,36 @@ class ProfileStore:
             return []
         return sorted(
             fn for fn in os.listdir(self.root) if fn.endswith(".jsonl"))
+
+    def best_blocks(self, kernel_sig: str, shape,
+                    metric: str = "device_ms") -> tuple[int, int] | None:
+        """The block pair of the lowest-``metric`` row across ALL key
+        files matching ``(kernel_sig, shape)`` — the autotuner's
+        consumer API (``core/blocktuner.py`` seeds its warm start
+        here).  Block keys are per-(sig, shape, blocks) files, so this
+        scans every key file, filters by signature + shape, and
+        returns the winning row's ``blocks`` as an int 2-tuple (None
+        when no matching row has a usable pair)."""
+        want_shape = list(shape) if isinstance(shape, (tuple, list)) \
+            else shape
+        rows: list[dict] = []
+        for fn in self.keys():
+            for r in self.read_key(fn):
+                if r.get("kernel_sig") != kernel_sig:
+                    break  # one key file == one (sig, shape, blocks)
+                if r.get("shape") != want_shape:
+                    break
+                rows.append(r)
+        best = self.best_row(rows, metric)
+        if best is None:
+            return None
+        blocks = best.get("blocks")
+        if not isinstance(blocks, (list, tuple)) or len(blocks) < 2:
+            return None
+        try:
+            return int(blocks[0]), int(blocks[1])
+        except (TypeError, ValueError):
+            return None
 
 
 #: The default store (``CK_PROFILE_STORE``-armed; disabled otherwise).

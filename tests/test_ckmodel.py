@@ -60,6 +60,7 @@ from cekirdekler_tpu.obs.replay import (  # noqa: E402
     verify_counterexample,
     verify_records,
 )
+from cekirdekler_tpu.core import blocktuner as BT  # noqa: E402
 from cekirdekler_tpu.serve import admission as A  # noqa: E402
 from cekirdekler_tpu.serve import coalescer as C  # noqa: E402
 from cekirdekler_tpu.serve import resilience as R  # noqa: E402
@@ -510,6 +511,48 @@ def _retry_machine(**kw):
     return M.RetryMachine(max_attempts=2, budget_cap=2, **kw)
 
 
+def _illegal_block_decide(current, walls, grid, hysteresis=0.08,
+                          seed=None, fallback=None):
+    """Engages a tile pair outside the legal grid — the unclamped
+    store-inherited pair the clamp exists to snap."""
+    choice, why = BT.block_transition(current, walls, grid,
+                                      hysteresis=hysteresis, seed=seed,
+                                      fallback=fallback)
+    if choice is not None:
+        return (64, 96), why
+    return choice, why
+
+
+def _flappy_block_decide(current, walls, grid, hysteresis=0.08,
+                         seed=None, fallback=None):
+    """Hysteresis filed off: always engages the instantaneous argmin,
+    so a ±noise re-measure flaps the choice (and the executable cache
+    behind it)."""
+    gset = set(grid)
+    known = sorted((tuple(p), float(w)) for p, w in walls
+                   if tuple(p) in gset)
+    if not known:
+        return BT.block_transition(current, walls, grid,
+                                   hysteresis=hysteresis, seed=seed,
+                                   fallback=fallback)
+    best = min(known, key=lambda kv: (kv[1], kv[0]))
+    cur = None if current is None else tuple(current)
+    return best[0], ("steady" if best[0] == cur else "model")
+
+
+def _stale_block_emit(row):
+    """Records the OUTGOING pair on a retune — the decision log
+    misstates what actually engaged (a retune that is visible in name
+    only; retune-visibility demands the row match the new choice)."""
+    cur = row["inputs"].get("current") or [0, 0]
+    return [dict(row, outputs=dict(row["outputs"],
+                                   block_q=cur[0], block_k=cur[1]))]
+
+
+def _block_machine(**kw):
+    return M.BlockMachine(**kw)
+
+
 #: invariant id -> machine factory with the broken seam injected.
 BROKEN_FIXTURES = {
     "breaker-half-open-one-probe":
@@ -566,12 +609,18 @@ BROKEN_FIXTURES = {
     "freeze-legal":
         lambda: _balance_machine(alphabet=(1.0,), balance=_freeze_mover),
     "converges": lambda: _balance_machine(balance=_oscillator),
+    "choice-legality":
+        lambda: _block_machine(decide=_illegal_block_decide),
+    "hysteresis-bound":
+        lambda: _block_machine(decide=_flappy_block_decide),
+    "retune-visibility":
+        lambda: _block_machine(emit=_stale_block_emit),
 }
 
 
 def test_fixture_table_covers_every_declared_invariant():
     declared = set()
-    for mod in (D, E, A, C, B, R):
+    for mod in (D, E, A, C, B, R, BT):
         declared |= {row[0] for row in mod.MODEL_INVARIANTS}
     assert set(BROKEN_FIXTURES) == declared
 
@@ -635,6 +684,20 @@ def test_broken_drain_trace_diverges_under_replay():
     assert verdict["first_divergence"]["seq"] >= 1
     assert verdict["first_divergence"]["kind"] in ("drain-apply",
                                                    "readmit")
+
+
+def test_broken_block_trace_diverges_under_replay():
+    """The block tamper drill: a hysteresis-free chooser's
+    counterexample carries flapped outputs; replaying through the real
+    block_transition names the first divergent seq."""
+    report = _block_machine(decide=_flappy_block_decide).explore()
+    v = next(x for x in report["violations"]
+             if x.invariant == "hysteresis-bound")
+    verdict = verify_counterexample(v)
+    assert verdict["ok"] is False
+    assert verdict["first_divergence"] is not None
+    assert verdict["first_divergence"]["seq"] >= 1
+    assert verdict["first_divergence"]["kind"] == "block-retune"
 
 
 def test_real_machine_trace_replays_green():

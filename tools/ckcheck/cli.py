@@ -75,6 +75,12 @@ HOT_ROOTS = (
     "utils.faultinject.FaultPlane.fire",
     "utils.faultinject.FaultPlane.delay_s",
     "utils.faultinject.FaultPlane.raise_if_fired",
+    # the block autotuner's choice path (ISSUE 16): sits on the flash
+    # default-argument path — metric handles cached at construction,
+    # the ProfileStore read happens once per key ever (outside the
+    # mutex), and decision/flight records emit only on a choice CHANGE
+    # behind the recorders' enabled flags
+    "core.blocktuner.BlockTuner.choose",
 )
 
 #: Locks the hot path may take: the scheduler lock + fused-window mutex
@@ -102,6 +108,10 @@ HOT_LOCK_ALLOW = (
     # miss for keys with no breaker state), nested inside the frontend
     # condition — the documented budget
     "serve.resilience.BreakerBoard._mu",
+    # block tuner: a few short value-copy critical sections per choose
+    # (snapshot walls / apply choice), never held across the store
+    # read or the recorders — the TransferTuner discipline
+    "core.blocktuner.BlockTuner._mu",
 )
 
 

@@ -118,8 +118,9 @@ def main(argv=None) -> int:
                     "controller state machines "
                     "(docs/STATIC_ANALYSIS.md)")
     ap.add_argument("--machine", choices=("drain", "elastic", "serve",
-                                          "balance", "resilience"),
-                    help="check one machine (default: all five + the "
+                                          "balance", "resilience",
+                                          "block"),
+                    help="check one machine (default: all six + the "
                          "purity lint)")
     ap.add_argument("--depth", type=int, default=None,
                     help="bound scale (default 1 = tier-1; env "

@@ -59,6 +59,11 @@ PURE_FUNCTIONS = (
     # (one re-split implementation — the PR 12 rule)
     ("cekirdekler_tpu/cluster/elastic.py", ("member_resplit",),
      ("ClusterLoadBalancer",)),
+    # the block autotuner's whole choice arithmetic — the stateful
+    # BlockTuner wrapper only snapshots inputs and applies outputs
+    ("cekirdekler_tpu/core/blocktuner.py",
+     ("block_transition", "legal_block_grid", "orient_block_grid",
+      "clamp_blocks"), ()),
 )
 
 #: Call roots that make a transition replay-inexact by construction.

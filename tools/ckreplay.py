@@ -77,6 +77,9 @@ def load_records(path: str):
 #: `jump_start=0.3` must not float-parse into truthy-on.
 _BOOL_KNOBS = frozenset(("jump_start", "transfer_floor", "smoothing"))
 _FLOAT_KNOBS = frozenset(("damping", "overhead_ms"))
+#: x-separated int lists (``--set`` splits entries on commas, so the
+#: grid knob separates its sizes with ``x``: ``block_grid=128x256x512``).
+_GRID_KNOBS = frozenset(("block_grid",))
 
 
 def parse_overrides(spec: str) -> dict:
@@ -107,6 +110,16 @@ def parse_overrides(spec: str) -> dict:
             else:
                 raise SystemExit(
                     f"ckreplay: bad value {v!r} for on/off knob {k!r}")
+        elif k in _GRID_KNOBS:
+            try:
+                sizes = tuple(int(s) for s in v.split("x") if s.strip())
+            except ValueError:
+                sizes = ()
+            if not sizes:
+                raise SystemExit(
+                    f"ckreplay: bad value {v!r} for grid knob {k!r} "
+                    "(want x-separated sizes, e.g. 128x256x512)")
+            out[k] = sizes
         else:
             assert k in _FLOAT_KNOBS, k  # WHATIF_KNOBS is the union
             try:
@@ -337,6 +350,15 @@ def main(argv=None) -> int:
                 if ch["factual"] != ch["counterfactual"]:
                     print(f"    seq={ch['seq']} lane={ch['lane']}: "
                           f"{ch['factual']} -> {ch['counterfactual']}")
+        if "block_choices" in rep:
+            print(f"  block choices: {rep['block_choices_changed']} of "
+                  f"{len(rep['block_choices'])} block-retune decisions "
+                  "changed")
+            for ch in rep["block_choices"]:
+                if ch["factual"] != ch["counterfactual"]:
+                    print(f"    seq={ch['seq']} {ch['kernel_sig']}: "
+                          f"{ch['factual']} -> {ch['counterfactual']} "
+                          f"({ch['why']})")
         return 0
 
     if args.cmd == "explain":

@@ -167,6 +167,31 @@ def _maybe_store(rep, args, shape, blocks) -> None:
         print(f"stored {prof.kernel} -> {path}")
 
 
+def _tuner_vs_best(store, best) -> str:
+    """The per-key honesty column (the overlap_sweep
+    ``choice_vs_optimum`` idiom): what the block tuner would ENGAGE for
+    this key — store-seeded, clamped to the legal tile grid — next to
+    the store's own best row, so a tuner that cannot cash in a
+    persisted profile is visible right where the profile lives."""
+    from cekirdekler_tpu.core.blocktuner import BlockTuner
+
+    sig, shape = best.get("kernel_sig"), best.get("shape")
+    blocks = best.get("blocks")
+    if not (sig and isinstance(shape, list) and shape
+            and isinstance(blocks, list) and len(blocks) >= 2
+            and all(isinstance(b, int) for b in blocks[:2])):
+        return "tuner: n/a (non-tile key)"
+    t = int(shape[1]) if len(shape) >= 2 else int(shape[0])
+    tuner = BlockTuner(store=store)
+    choice = tuner.choose(sig, t, t, shape=tuple(shape))
+    stored = (int(blocks[0]), int(blocks[1]))
+    # disagreement is either the store's cross-key global best winning
+    # over this key's row, or grid-legality clamping — both honest
+    verdict = "agree" if choice == stored else (
+        "dense-fallback" if choice is None else "differs")
+    return f"tuner {choice} vs store best {stored} [{verdict}]"
+
+
 def show_store(args) -> int:
     from cekirdekler_tpu.trace.device import ProfileStore
 
@@ -185,7 +210,8 @@ def show_store(args) -> int:
         best = ProfileStore.best_row(rows) or rows[-1]
         print(f"  {fn}: {len(rows)} row(s), best device_ms="
               f"{best.get('device_ms')} (kernel {best.get('kernel_sig')}, "
-              f"shape {best.get('shape')}, blocks {best.get('blocks')})")
+              f"shape {best.get('shape')}, blocks {best.get('blocks')}); "
+              f"{_tuner_vs_best(store, best)}")
     return 0
 
 
