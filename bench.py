@@ -698,6 +698,11 @@ RESERVED_SECTIONS = {"flash_train": 360.0, "marker_overhead": 60.0,
                      # round one — a gate metric without a reservation
                      # starves (the r4/r5 lesson)
                      "serving": 60.0,
+                     # the cluster serving fabric (ISSUE 17): the
+                     # single-vs-sharded faceoff + the seeded mid-run
+                     # member-kill drill minting the regression-watched
+                     # fabric_chaos_goodput_frac
+                     "serving_fabric": 90.0,
                      # the recovery tier (ISSUE 13): seeded
                      # drain-and-readmit + kill-and-rejoin scenarios
                      # minting drain_recover_ms / rejoin_converge_iters
@@ -1112,6 +1117,17 @@ def main() -> None:
     serving = section(
         "serving", lambda: _load_loadgen().loadgen_section(devs))
 
+    # Cluster serving fabric (ISSUE 17): the SAME closed-loop workload
+    # against one frontend vs a 3-member ServeFabric at 128 clients
+    # (placement = consistent hash over the member ring, every verdict
+    # a replayable `route` decision), plus the seeded mid-run member
+    # kill whose in-flight requests must re-route onto the survivors
+    # bit-exactly (docs/SERVING.md "Cluster fabric"; tools/loadgen.py
+    # --fabric N is the standalone CLI).
+    serving_fabric = section(
+        "serving_fabric",
+        lambda: _load_loadgen().fabric_section(devs, clients=128))
+
     # Recovery tier (ISSUE 13): one seeded drain-and-readmit scenario
     # (an injected lane stall is quarantined by the DrainController,
     # the share redistributed, the lane re-admitted when the injection
@@ -1204,6 +1220,7 @@ def main() -> None:
         "nbody_e2e": nbe,
         "dispatch_floor": dfloor,
         "serving": serving,
+        "serving_fabric": serving_fabric,
         "resilience": resilience,
         "nbody_note": (
             "nbody_gpairs_per_sec = sync-per-call variant (host fence "
@@ -1320,6 +1337,27 @@ def main() -> None:
             "serve_chaos_p99_ms": (
                 serving.get("chaos_p99_ms")
                 if isinstance(serving, dict) else None
+            ),
+            # the cluster fabric's keys (ISSUE 17): sharded-frontend
+            # goodput/p99 vs the single-frontend baseline at the same
+            # load, and the kill-and-reroute drill's goodput-retained
+            # fraction (exactness-gated to None inside fabric_section
+            # when any fabric chaos contract was violated)
+            "fabric_goodput_rps": (
+                serving_fabric.get("fabric_goodput_rps")
+                if isinstance(serving_fabric, dict) else None
+            ),
+            "fabric_p99_ms": (
+                serving_fabric.get("fabric_p99_ms")
+                if isinstance(serving_fabric, dict) else None
+            ),
+            "fabric_goodput_speedup": (
+                serving_fabric.get("fabric_goodput_speedup")
+                if isinstance(serving_fabric, dict) else None
+            ),
+            "fabric_chaos_goodput_frac": (
+                serving_fabric.get("fabric_chaos_goodput_frac")
+                if isinstance(serving_fabric, dict) else None
             ),
             # the recovery tier's keys (ISSUE 13): wall from injected
             # degradation to the drain taking effect, and post-resume

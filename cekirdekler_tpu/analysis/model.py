@@ -67,6 +67,7 @@ __all__ = [
     "MachineBase",
     "DrainMachine",
     "ElasticMachine",
+    "RouterMachine",
     "AdmissionMachine",
     "CoalesceMachine",
     "BalanceMachine",
@@ -86,9 +87,11 @@ __all__ = [
 #: coalesce sub-machines (one serving tier, two pure planners);
 #: ``resilience`` groups the breaker, brownout-shed and retry-budget
 #: machines (``serve/resilience.py``); ``block`` explores the tile
-#: autotuner's choice transition (``core/blocktuner.py``).
+#: autotuner's choice transition (``core/blocktuner.py``); ``router``
+#: explores the serving fabric's consistent-hash placement
+#: (``serve/fabric.py``).
 MACHINE_NAMES = ("drain", "elastic", "serve", "balance", "resilience",
-                 "block")
+                 "block", "router")
 
 #: Deepen-on-the-bench-rig knob: a positive integer scales the bounds
 #: (balancer horizon, starvation caps, rate alphabet) beyond tier-1.
@@ -685,6 +688,208 @@ class ElasticMachine(MachineBase):
                         "resplit-quantized",
                         f"{r['kind']} member {i} share {v} is not a "
                         f"non-negative LCM({lcm}) multiple"))
+        return bad
+
+
+# ---------------------------------------------------------------------------
+# router: roster × health-view interleavings (serve/fabric.py)
+# ---------------------------------------------------------------------------
+
+class RouterMachine(MachineBase):
+    """Every (roster subset × unhealthy subset) over a small member
+    alphabet, driving a REAL :class:`~..serve.fabric.ShardRouter` over
+    a real :class:`~..cluster.elastic.Membership` for a fixed key set
+    at every transition — the checked rows are the ``route`` records
+    the live site emitted, not a re-model.
+
+    ``route`` is the injectable placement seam (the pure
+    ``route_decision`` by default) so the test suite's deliberately-
+    broken fixtures produce counterexamples for every declared
+    invariant: a flip-flopping fn breaks placement-deterministic, a
+    modulo (non-consistent) hash breaks minimal-reshuffle, a fixed
+    off-roster target breaks routes-to-members, a silent diverter
+    breaks diversion-named."""
+
+    name = "router"
+    checks = ("placement-deterministic", "minimal-reshuffle",
+              "routes-to-members", "diversion-named")
+
+    #: the fixed (tenant, key) probe set — few enough that every edge
+    #: stays cheap, spread enough that a 3-member ring places them on
+    #: more than one owner
+    KEYS = (("tA", "k1"), ("tA", "k2"), ("tB", "k1"), ("tB", "k3"),
+            ("tC", "k4"))
+
+    def __init__(self, member_ids=("p0", "p2", "p10"), route=None,
+                 keys=None):
+        from ..serve import fabric as F
+
+        self.invariants = F.MODEL_INVARIANTS
+        super().__init__()
+        self.F = F
+        self.member_ids = tuple(member_ids)
+        self.route_fn = route  # None = ShardRouter's real pure default
+        if keys is not None:
+            self.KEYS = tuple(keys)
+
+    def initial_states(self):
+        # every non-empty roster, all-healthy (empty rosters and sick
+        # shards are reached through leave/mark edges)
+        ids = self.member_ids
+        out = []
+        for mask in range(1, 1 << len(ids)):
+            roster = tuple(
+                ids[i] for i in range(len(ids)) if mask >> i & 1)
+            out.append((roster, ()))
+        return out
+
+    def state_doc(self, state):
+        return {"roster": list(state[0]), "unhealthy": list(state[1])}
+
+    def _drive(self, roster, unhealthy):
+        """Route every probe key through a real router at this
+        roster/health view; returns ``(outs, rows)`` — the verdicts by
+        key and the harvested ``route`` records."""
+        from ..cluster.elastic import Membership
+
+        m = Membership()
+        m.establish({mm: 1 for mm in roster})
+        router = self.F.ShardRouter(m, route=self.route_fn)
+        for u in unhealthy:
+            router.mark(u)
+        mark = _last_seq()
+        outs = {}
+        for tenant, key in self.KEYS:
+            outs[(tenant, key)] = router.route(tenant, key)
+        return outs, _harvest(mark)
+
+    def actions(self, state):
+        roster, unhealthy = state
+        rset, uset = set(roster), set(unhealthy)
+        edges = []
+        for m in self.member_ids:
+            if m in rset:
+                edges.append((
+                    f"leave:{m}",
+                    (tuple(x for x in roster if x != m),
+                     tuple(x for x in unhealthy if x != m))))
+                if m not in uset:
+                    edges.append((
+                        f"mark:{m}",
+                        (roster, tuple(sorted(uset | {m})))))
+            else:
+                edges.append((f"join:{m}",
+                              (tuple(sorted(rset | {m})), unhealthy)))
+            if m in uset:
+                edges.append((
+                    f"clear:{m}",
+                    (roster, tuple(x for x in unhealthy if x != m))))
+        out = []
+        for label, nxt in edges:
+            _outs, rows = self._drive(*nxt)
+            out.append((label, rows, nxt))
+        return out
+
+    def check_action(self, state, label, rows, nxt):
+        bad = []
+        F = self.F
+        route_fn = self.route_fn or F.route_decision
+        outs2, rows2 = self._drive(*nxt)
+        # placement-deterministic: the same (roster, health view)
+        # driven twice records bit-identical verdicts, and every
+        # recorded output re-derives from its recorded inputs (the
+        # ckreplay contract, checked in the explorer)
+        self._hit("placement-deterministic")
+        sig = [(r["inputs"]["tenant"], r["inputs"]["key"],
+                r["outputs"]) for r in rows]
+        sig2 = [(r["inputs"]["tenant"], r["inputs"]["key"],
+                 r["outputs"]) for r in rows2]
+        if sig != sig2:
+            bad.append((
+                "placement-deterministic",
+                f"two drives of {nxt} recorded different placements"))
+        for r in rows:
+            inp, outp = r["inputs"], r["outputs"]
+            re = route_fn(inp["tenant"], inp["key"],
+                          list(inp["members"]),
+                          tuple(inp["unhealthy"]),
+                          int(inp["epoch"]))
+            if dict(re) != dict(outp):
+                bad.append((
+                    "placement-deterministic",
+                    f"route({inp['tenant']},{inp['key']}) recorded "
+                    f"{outp} but re-derives to {re}"))
+        # routes-to-members: never a non-member target; a refusal only
+        # with no healthy member, and then with the named reason
+        self._hit("routes-to-members")
+        for r in rows:
+            inp, o = r["inputs"], r["outputs"]
+            members = set(inp["members"])
+            healthy = members - set(inp["unhealthy"])
+            shard = o.get("shard")
+            if shard is None:
+                if o.get("reason") != F.REJECT_SHARD:
+                    bad.append((
+                        "routes-to-members",
+                        f"refusal without the named {F.REJECT_SHARD} "
+                        f"reason (got {o.get('reason')!r})"))
+                if healthy:
+                    bad.append((
+                        "routes-to-members",
+                        f"refused while healthy members {sorted(healthy)} "
+                        "existed"))
+            elif shard not in members:
+                bad.append((
+                    "routes-to-members",
+                    f"routed to {shard!r}, not in the epoch's roster "
+                    f"{sorted(members)}"))
+        # diversion-named: off-owner placement is flagged with hops,
+        # and a healthy owner is never diverted away from
+        self._hit("diversion-named")
+        for r in rows:
+            inp, o = r["inputs"], r["outputs"]
+            if o.get("shard") is None:
+                continue
+            if o["shard"] != o.get("owner"):
+                if not o.get("diverted") or int(o.get("hops") or 0) < 1:
+                    bad.append((
+                        "diversion-named",
+                        f"route landed on {o['shard']} away from owner "
+                        f"{o.get('owner')} without the diverted flag / "
+                        "hop count — a silent diversion"))
+                if o.get("owner") not in set(inp["unhealthy"]):
+                    bad.append((
+                        "diversion-named",
+                        f"diverted away from HEALTHY owner "
+                        f"{o.get('owner')}"))
+            elif o.get("diverted"):
+                bad.append((
+                    "diversion-named",
+                    "owner placement flagged as diverted"))
+        # minimal-reshuffle on membership edges: a key's ring OWNER
+        # (health-blind) may move only when the departed member owned
+        # it (leave) or the joiner captured it (join)
+        kind, _, member = label.partition(":")
+        if kind in ("leave", "join"):
+            self._hit("minimal-reshuffle")
+            before, _r = self._drive(*state)
+            for k in self.KEYS:
+                ob = before[k].get("owner")
+                oa = outs2[k].get("owner")
+                if ob == oa:
+                    continue
+                if kind == "leave" and ob != member:
+                    bad.append((
+                        "minimal-reshuffle",
+                        f"leave({member}) moved key {k} owned by "
+                        f"{ob} (to {oa}) — only the departed member's "
+                        "keys may move"))
+                if kind == "join" and oa != member:
+                    bad.append((
+                        "minimal-reshuffle",
+                        f"join({member}) moved key {k} from {ob} to "
+                        f"{oa} — only keys the joiner captures may "
+                        "move"))
         return bad
 
 
@@ -1903,6 +2108,12 @@ def build_machines(name: str, quick: bool = False,
                 ShedMachine(engage_streak=1 + scale),
                 RetryMachine(max_attempts=1 + scale,
                              budget_cap=1 + scale)]
+    if name == "router":
+        if quick:
+            return [RouterMachine(member_ids=("p0", "p2"))]
+        ids = ("p0", "p2", "p10") if scale == 1 else \
+            ("p0", "p2", "p10", "p3")[:3 + min(scale - 1, 1)]
+        return [RouterMachine(member_ids=ids)]
     if name == "block":
         if quick:
             return [BlockMachine(tq=256, tk=256,

@@ -168,6 +168,18 @@ class CruncherClient:
                 raise ClusterRetryExhausted(
                     op, self.max_retries + 1, last_exc) from last_exc
         if reply.command == Command.ANSWER_ERROR:
+            if reply.meta.get("reject") and len(reply.strings) >= 3:
+                # a serving-tier rejection (circuit-open / brownout /
+                # shard-unavailable / ...): re-raise the SAME typed
+                # error a local caller gets, named reason and
+                # retry-after hint intact (never retried here — an
+                # application reply is deterministic, not transport)
+                from ..serve.admission import ServeRejected
+
+                raise ServeRejected(
+                    str(reply.strings[2]),
+                    str(reply.strings[1]),
+                    reply.meta.get("retry_after_us", 0) / 1e6)
             raise CekirdeklerError(
                 f"remote error: {reply.strings and reply.strings[0]}")
         return reply

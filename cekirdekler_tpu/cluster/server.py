@@ -36,6 +36,30 @@ from .netbuffer import (
 __all__ = ["CruncherServer"]
 
 
+def _error_reply(e: Exception) -> Message:
+    """The ANSWER_ERROR for one failed operation.  A serving-tier
+    rejection (``serve/admission.ServeRejected`` — including the
+    fabric's ``shard-unavailable``) carries its NAMED reason, tenant,
+    and retry-after hint in ``meta`` so the remote client re-raises
+    the same typed error a local caller gets (detected structurally —
+    by the reason/tenant/retry attributes — so this module never
+    imports the serve package and no import cycle forms).  The wire
+    meta dict is int-valued by format, so the reason and tenant ride
+    the strings list behind the message text and the retry hint rides
+    as integer microseconds."""
+    reason = getattr(e, "reason", None)
+    tenant = getattr(e, "tenant", None)
+    retry_after = getattr(e, "retry_after_s", None)
+    if isinstance(reason, str) and tenant is not None \
+            and retry_after is not None:
+        return Message(
+            Command.ANSWER_ERROR,
+            meta={"reject": 1,
+                  "retry_after_us": int(float(retry_after) * 1e6)},
+            strings=[str(e), reason, str(tenant)])
+    return Message(Command.ANSWER_ERROR, strings=[str(e)])
+
+
 class _ClientSession(threading.Thread):
     """Per-connection state + dispatch loop (reference:
     ClCruncherServerThread)."""
@@ -98,7 +122,7 @@ class _ClientSession(threading.Thread):
                 Message(Command.ANSWER_SETUP, meta={"n": self.cruncher.num_devices}),
             )
         except Exception as e:
-            send_message(self.conn, Message(Command.ANSWER_ERROR, strings=[str(e)]))
+            send_message(self.conn, _error_reply(e))
 
     def _compute(self, msg: Message) -> None:
         try:
@@ -151,7 +175,7 @@ class _ClientSession(threading.Thread):
                 )
             send_message(self.conn, reply)
         except Exception as e:
-            send_message(self.conn, Message(Command.ANSWER_ERROR, strings=[str(e)]))
+            send_message(self.conn, _error_reply(e))
 
     def _dispose(self) -> None:
         if self.cruncher is not None:

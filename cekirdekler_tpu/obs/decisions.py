@@ -117,6 +117,7 @@ DECISION_KINDS = (
     "member-join",         # cluster/elastic — a member arrived, re-split
     "checkpoint-restore",  # cluster/elastic — a run resumed from a window ckpt
     "block-retune",        # core/blocktuner — tile/block choice engaged/moved
+    "route",               # serve/fabric — one shard-placement verdict
 )
 
 #: The subset replay-verify re-executes: decisions that are pure
@@ -128,7 +129,7 @@ REPLAYABLE_KINDS = (
     "admission", "coalesce",
     "breaker", "shed", "retry", "containment",
     "drain-apply", "readmit", "member-leave", "member-join",
-    "block-retune",
+    "block-retune", "route",
 )
 
 #: The complement, DECLARED: every decision kind is placed in exactly

@@ -96,6 +96,7 @@ EVENT_KINDS = (
     "drain-apply", "readmit", "drain-probe",
     "member-leave", "member-join",
     "checkpoint-restore", "checkpoint-fallback", "checkpoint-sweep",
+    "fabric-divert", "fabric-reroute", "fabric-warm",
 )
 
 #: Postmortem JSON schema tag.  v2 (this revision) embeds the decision

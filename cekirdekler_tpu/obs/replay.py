@@ -491,6 +491,24 @@ def _replay_block_retune(inp: dict, out: dict) -> dict:
     return mism
 
 
+def _replay_route(inp: dict, out: dict) -> dict:
+    """route: one shard-placement verdict (serve/fabric.py) — the
+    pure consistent-hash + diversion walk re-executed from the
+    recorded roster, health view, and epoch."""
+    from ..serve.fabric import route_decision
+
+    got = route_decision(
+        str(inp.get("tenant", "")), str(inp.get("key", "")),
+        list(inp.get("members") or ()),
+        tuple(inp.get("unhealthy") or ()),
+        int(inp.get("epoch", 0)))
+    mism: dict = {}
+    for k in ("shard", "owner", "diverted", "hops", "reason", "epoch"):
+        if got.get(k) != out.get(k):
+            mism[k] = {"expected": out.get(k), "got": got.get(k)}
+    return mism
+
+
 _REPLAYERS = {
     "load-balance": _replay_load_balance,
     "transfer-choose": _replay_transfer_choose,
@@ -507,6 +525,7 @@ _REPLAYERS = {
     "member-leave": _replay_member,
     "member-join": _replay_member,
     "block-retune": _replay_block_retune,
+    "route": _replay_route,
 }
 assert set(_REPLAYERS) == set(REPLAYABLE_KINDS)
 

@@ -27,6 +27,15 @@ from .admission import (
     admit_decision,
 )
 from .coalescer import STARVE_ROUNDS, plan_coalesce
+from .fabric import (
+    REJECT_SHARD,
+    ServeFabric,
+    ShardRouter,
+    fabric_key,
+    merge_shard_serving,
+    route_decision,
+    shard_health,
+)
 from .frontend import ServeFrontend, ServeJob, servez_payload
 from .resilience import (
     BreakerBoard,
@@ -43,11 +52,14 @@ from .tenants import TenantTable
 __all__ = [
     "AdmissionController",
     "BreakerBoard",
+    "REJECT_SHARD",
     "ResilienceConfig",
     "RetryBudgets",
+    "ServeFabric",
     "ServeFrontend",
     "ServeJob",
     "ServeRejected",
+    "ShardRouter",
     "TenantQuota",
     "TenantTable",
     "STARVE_ROUNDS",
@@ -56,7 +68,11 @@ __all__ = [
     "breaker_transition",
     "brownout_transition",
     "containment_plan",
+    "fabric_key",
+    "merge_shard_serving",
     "plan_coalesce",
     "retry_decision",
+    "route_decision",
     "servez_payload",
+    "shard_health",
 ]

@@ -247,6 +247,11 @@ class ServeFrontend:
             "ck_serve_dispatcher_crashes_total",
             "serve dispatcher threads lost to an escaping exception "
             "(in-flight futures failed with the named error)")
+        self._m_warmups = REGISTRY.counter(
+            "ck_serve_warmup_total",
+            "job signatures precompiled by ServeFrontend.warmup (the "
+            "cold-start ladder-set warm — ROADMAP item 4's minimal "
+            "slice)")
         _register_frontend(self)
         if autostart:
             self.start()
@@ -901,6 +906,40 @@ class ServeFrontend:
                     "engage_streak": rc.brownout_engage_streak,
                 }, dict(out))
         return out
+
+    def warmup(self, jobs) -> dict:
+        """Precompile the ladder set for a list of jobs (cold-start
+        warmup — ROADMAP item 4's minimal slice; the full on-disk
+        compile cache stays future work): each DISTINCT signature
+        dispatches one single-iteration fused batch through the same
+        ``compute_fused_batch`` path a coalesced batch rides, so the
+        shape-only executable cache turns every later batch into a
+        compile hit.  The warm iteration EXECUTES — it mutates the
+        given jobs' arrays — so callers warm with scratch params of
+        the production shapes (``ServeFabric`` does; shapes are the
+        cache key, identities are not).  Counted via
+        ``ck_serve_warmup_total``; returns ``{"warmed": n}``."""
+        seen: set = set()
+        warmed = 0
+        for job in jobs:
+            jb = job if isinstance(job, ServeJob) else ServeJob(**job)
+            sig = jb.signature()
+            if sig in seen:
+                continue
+            seen.add(sig)
+            with self._step_mu:
+                if not self.cores.enqueue_mode:
+                    self.cores.enqueue_mode = True
+                self.cores.compute_fused_batch(
+                    list(jb.kernels), list(jb.params), jb.compute_id,
+                    jb.global_range, jb.local_range, 1,
+                    global_offset=jb.global_offset,
+                    value_args=jb.values)
+                self.cores.barrier()
+                self.cores.flush()
+            self._m_warmups.inc()
+            warmed += 1
+        return {"warmed": warmed}
 
     # -- views / lifecycle ---------------------------------------------------
     def stats(self) -> dict:

@@ -95,6 +95,11 @@ class ClusterSnapshot(dict):
       shipped none) — feed
       :func:`cekirdekler_tpu.obs.health.cluster_health_table` for the
       job-wide verdict table
+    - ``serving``: per-process serving stats (``{}`` for a process
+      that shipped none) — per-shard ``ServeFrontend.stats()`` docs
+      keyed by member; feed
+      :func:`cekirdekler_tpu.serve.fabric.merge_shard_serving` for the
+      job-wide serving totals
     - ``nproc``
 
     (a dict subclass so it JSON-serializes untouched; spans are listed
@@ -124,6 +129,7 @@ def gather_cluster(
     rounds: int = 5,
     skew_s: float = 0.0,
     health: dict | None = None,
+    serving: dict | None = None,
 ) -> ClusterSnapshot:
     """Ship this process's spans + metrics + lane-health report to the
     cluster; return the merged, clock-aligned view (SPMD — every
@@ -165,7 +171,8 @@ def gather_cluster(
     # whole cluster gather — every peer decodes this payload strictly
     payload = json.dumps(
         json_safe(
-            {"spans": rows, "metrics": metrics_snapshot, "health": health}
+            {"spans": rows, "metrics": metrics_snapshot, "health": health,
+             "serving": serving or {}}
         ),
         allow_nan=False,
     ).encode()
@@ -179,6 +186,7 @@ def gather_cluster(
     per_proc_spans: list[list[Span]] = []
     per_proc_metrics: list[dict] = []
     per_proc_health: list[dict] = []
+    per_proc_serving: list[dict] = []
     for p in range(len(sizes)):
         decoded = json.loads(
             gathered[p, : int(sizes[p])].tobytes().decode()
@@ -188,11 +196,14 @@ def gather_cluster(
         # .get: a peer running a pre-health build ships no key — its
         # absence stays visible as {} in the table, never an implied ok
         per_proc_health.append(decoded.get("health") or {})
+        # same rule for serving stats (pre-fabric peers ship no key)
+        per_proc_serving.append(decoded.get("serving") or {})
     return ClusterSnapshot(
         offsets=offsets,
         spans=per_proc_spans,
         metrics=per_proc_metrics,
         health=per_proc_health,
+        serving=per_proc_serving,
         nproc=len(sizes),
     )
 

@@ -1,0 +1,245 @@
+"""Worker process for the serving fabric: ONE shard of a multi-process
+fabric — a ``ServeFrontend`` over its own ``NumberCruncher`` in its own
+interpreter (the ``tests/_dcn_worker.py`` idiom: parent spawns N of
+these, each prints a READY sentinel, then obeys a JSON-lines command
+protocol on stdin/stdout).  Used by ``tests/test_fabric.py`` (the
+seeded kill-and-reroute drill SIGKILLs one of these mid-stream) and by
+``tools/loadgen.py --fabric N`` (the multi-process goodput run).
+
+Run as ``python tests/_fabric_worker.py <member> <n> <local_range>
+[max_queue_depth] [gather_window_ms]`` — the optional queue bound makes
+the shard run the SAME per-process admission configuration the
+single-process baseline runs (per-process queue bounds are exactly the
+state sharding scales); the gather window is per-shard config (a shard
+seeing 1/N of the clients gathers ~N× longer to fill the same fused
+batch — the equal-batch-size normalization).
+
+Protocol (one JSON object per line; every command gets one reply):
+
+- ``{"op": "warm", "sigs": [si, ...]}`` — precompile the ladder set
+  for those signatures via ``ServeFrontend.warmup`` →
+  ``{"op": "warmed", "warmed": k}``
+- ``{"op": "serve", "assignments": [[tenant, si, clients, requests],
+  ...]}`` — closed-loop client threads against the local frontend →
+  ``{"op": "done", "completed", "per_sig", "latencies_ms", "wall_s",
+  "hangs", "failed", "unnamed_failures", "failure_causes", "rejected",
+  "checked"}``
+- ``{"op": "run", "rid": i, "tenant": t, "sig": si, "iters": k}`` —
+  k sequential blocking requests (the kill-test unit of work; the
+  reply IS the ack — a SIGKILLed worker never acks, so the parent
+  re-routes exactly the unacked rids) → ``{"op": "done", "rid", "sig",
+  "count"}``
+- ``{"op": "value", "sig": si}`` — the signature array's value (bit-
+  exactness evidence: every element must equal the applied count) →
+  ``{"op": "value", "sig", "value", "uniform": bool}``
+- ``{"op": "stats"}`` — the frontend ``stats()`` doc (the shard-health
+  input) → ``{"op": "stats", "stats": {...}}``
+- ``{"op": "exit"}`` → ``{"op": "bye"}`` and a clean close.
+
+The workload kernel is loadgen's ``lg_inc`` (+1.0f per request):
+small-integer f32 math is exact, so lost or double-applied requests
+are integer-visible in the array.
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+SRC = """
+__kernel void lg_inc(__global float* a) {
+    int i = get_global_id(0);
+    a[i] = a[i] + 1.0f;
+}
+"""
+
+
+def main(member: str, n: int, local_range: int,
+         max_queue_depth: int = 0,
+         gather_window_ms: float = 4.0) -> None:
+    import numpy as np
+
+    from cekirdekler_tpu import ClArray
+    from cekirdekler_tpu.core.cruncher import NumberCruncher
+    from cekirdekler_tpu.errors import CekirdeklerError
+    from cekirdekler_tpu.hardware import all_devices
+    from cekirdekler_tpu.serve import (
+        AdmissionController,
+        ServeFrontend,
+        ServeJob,
+        ServeRejected,
+    )
+
+    devs = all_devices().cpus()
+    devs = devs.subset(min(2, len(devs)) or 1)
+    cr = NumberCruncher(devs, SRC)
+    admission = None
+    if max_queue_depth > 0:
+        admission = AdmissionController(
+            max_queue_depth=max_queue_depth,
+            health=cr.cores.drain.healthy_with_drains)
+    fe = ServeFrontend(cr, admission=admission, max_batch=512,
+                       gather_window_s=gather_window_ms / 1000.0,
+                       name=f"fabric-{member}")
+    arrays: dict = {}
+    jobs: dict = {}
+
+    def job_for(si: int):
+        if si not in jobs:
+            a = ClArray(np.zeros(n, np.float32), name=f"w{member}_{si}")
+            a.partial_read = True
+            arrays[si] = a
+            jobs[si] = ServeJob(
+                params=[a], kernels=["lg_inc"], compute_id=9100 + si,
+                global_range=n, local_range=local_range)
+        return jobs[si]
+
+    def op_serve(cmd: dict) -> dict:
+        completed: dict = {}
+        latencies: list = []
+        rejected = [0]
+        failed = [0]
+        hangs = [0]
+        unnamed = [0]
+        causes: dict = {}
+        mu = threading.Lock()
+        # build jobs up front: array construction must not ride the
+        # timed section
+        for tenant, si, n_clients, requests in cmd["assignments"]:
+            job_for(int(si))
+
+        def client(tenant: str, si: int, requests: int):
+            job = jobs[si]
+            for _ in range(int(requests)):
+                fut = None
+                for _attempt in range(50):
+                    try:
+                        fut = fe.submit(tenant, job)
+                        break
+                    except ServeRejected as e:
+                        with mu:
+                            rejected[0] += 1
+                        time.sleep(min(e.retry_after_s, 0.25))
+                if fut is None:
+                    continue
+                try:
+                    r = fut.result(timeout=60.0)
+                except Exception as e:  # noqa: BLE001 - counted below
+                    with mu:
+                        if isinstance(e, TimeoutError) or \
+                                type(e).__name__ == "TimeoutError":
+                            hangs[0] += 1
+                        else:
+                            failed[0] += 1
+                            cause = type(e).__name__
+                            causes[cause] = causes.get(cause, 0) + 1
+                            if not isinstance(e, CekirdeklerError):
+                                unnamed[0] += 1
+                    continue
+                with mu:
+                    latencies.append(r["latency_s"])
+                    completed[si] = completed.get(si, 0) + 1
+
+        threads = []
+        for tenant, si, n_clients, requests in cmd["assignments"]:
+            for _ in range(int(n_clients)):
+                threads.append(threading.Thread(
+                    target=client, args=(str(tenant), int(si),
+                                         int(requests)), daemon=True))
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300.0)
+        wall = time.perf_counter() - t0
+        checked = all(
+            bool(np.all(np.asarray(arrays[si]) == float(cnt)))
+            for si, cnt in completed.items()
+        )
+        return {
+            "op": "done", "member": member,
+            "completed": sum(completed.values()),
+            "per_sig": {str(k): v for k, v in sorted(completed.items())},
+            "latencies_ms": [round(v * 1000.0, 3) for v in latencies],
+            "wall_s": round(wall, 4),
+            "hangs": hangs[0], "failed": failed[0],
+            "unnamed_failures": unnamed[0],
+            "failure_causes": dict(sorted(causes.items())),
+            "rejected": rejected[0],
+            "checked": checked,
+        }
+
+    def op_run(cmd: dict) -> dict:
+        si = int(cmd["sig"])
+        job = job_for(si)
+        tenant = str(cmd.get("tenant", "t0"))
+        done = 0
+        for _ in range(int(cmd.get("iters", 1))):
+            fe.call(tenant, job, timeout=60.0)
+            done += 1
+        return {"op": "done", "rid": cmd.get("rid"), "sig": si,
+                "count": done}
+
+    def op_value(cmd: dict) -> dict:
+        si = int(cmd["sig"])
+        a = np.asarray(arrays[si]) if si in arrays else np.zeros(1)
+        return {"op": "value", "sig": si, "value": float(a[0]),
+                "uniform": bool(np.all(a == a[0]))}
+
+    print(f"FABRIC_READY member={member}", flush=True)
+    try:
+        for line in sys.stdin:
+            line = line.strip()
+            if not line:
+                continue
+            cmd = json.loads(line)
+            op = cmd.get("op")
+            if op == "warm":
+                # scratch params: warmup EXECUTES its jobs (the warm
+                # iteration mutates), so never warm the real arrays —
+                # the shape-only executable cache makes the real jobs
+                # compile hits anyway
+                scratch = []
+                for si in cmd["sigs"]:
+                    a = ClArray(np.zeros(n, np.float32),
+                                name=f"scratch{si}")
+                    a.partial_read = True
+                    scratch.append(ServeJob(
+                        params=[a], kernels=["lg_inc"],
+                        compute_id=9100 + int(si), global_range=n,
+                        local_range=local_range))
+                got = fe.warmup(scratch)
+                reply = {"op": "warmed", "warmed": got["warmed"]}
+            elif op == "serve":
+                reply = op_serve(cmd)
+            elif op == "run":
+                reply = op_run(cmd)
+            elif op == "value":
+                reply = op_value(cmd)
+            elif op == "stats":
+                reply = {"op": "stats", "stats": {
+                    k: v for k, v in fe.stats().items()
+                    if k in ("queue_depth", "dispatcher_alive",
+                             "requests_done", "batches")}}
+            elif op == "exit":
+                print(json.dumps({"op": "bye"}), flush=True)
+                break
+            else:
+                reply = {"op": "error", "error": f"bad op {op!r}"}
+            print(json.dumps(reply), flush=True)
+    finally:
+        fe.close(drain=False)
+        cr.dispose()
+
+
+if __name__ == "__main__":
+    main(
+        sys.argv[1] if len(sys.argv) > 1 else "m0",
+        int(sys.argv[2]) if len(sys.argv) > 2 else 1 << 13,
+        int(sys.argv[3]) if len(sys.argv) > 3 else 64,
+        int(sys.argv[4]) if len(sys.argv) > 4 else 0,
+        float(sys.argv[5]) if len(sys.argv) > 5 else 4.0,
+    )

@@ -63,6 +63,7 @@ from cekirdekler_tpu.obs.replay import (  # noqa: E402
 from cekirdekler_tpu.core import blocktuner as BT  # noqa: E402
 from cekirdekler_tpu.serve import admission as A  # noqa: E402
 from cekirdekler_tpu.serve import coalescer as C  # noqa: E402
+from cekirdekler_tpu.serve import fabric as F  # noqa: E402
 from cekirdekler_tpu.serve import resilience as R  # noqa: E402
 
 import tools.ckmodel.cli as ckmodel_cli  # noqa: E402
@@ -553,6 +554,74 @@ def _block_machine(**kw):
     return M.BlockMachine(**kw)
 
 
+class _FlipRoute:
+    """Alternate calls bounce the same key between members — the
+    drive/re-drive comparison (and any replay) diverges."""
+
+    def __init__(self):
+        self.calls = 0
+
+    def __call__(self, tenant, key, members, unhealthy=(), epoch=0):
+        out = F.route_decision(tenant, key, members, unhealthy, epoch)
+        self.calls += 1
+        roster = sorted(set(str(m) for m in members),
+                        key=lambda m: (len(m), m))
+        if out["shard"] is not None and len(roster) > 1 and \
+                self.calls % 2:
+            alt = roster[(roster.index(out["shard"]) + 1) % len(roster)]
+            return dict(out, shard=alt, owner=alt)
+        return out
+
+
+def _modulo_route(tenant, key, members, unhealthy=(), epoch=0):
+    """Placement by hash MOD roster size — the NON-consistent hash
+    minimal-reshuffle exists to forbid: one departure reshuffles keys
+    between the survivors."""
+    import hashlib as _hl
+
+    roster = sorted(set(str(m) for m in members),
+                    key=lambda m: (len(m), m))
+    if not roster:
+        return F.route_decision(tenant, key, members, unhealthy, epoch)
+    h = int(_hl.sha256(f"{tenant}|{key}".encode()).hexdigest()[:16], 16)
+    owner = roster[h % len(roster)]
+    bad = set(str(m) for m in unhealthy)
+    shard, hops = None, 0
+    for i in range(len(roster)):
+        m = roster[(h + i) % len(roster)]
+        if m not in bad:
+            shard = m
+            break
+        hops += 1
+    if shard is None:
+        return {"shard": None, "owner": owner, "diverted": True,
+                "hops": hops, "reason": F.REJECT_SHARD,
+                "epoch": int(epoch)}
+    return {"shard": shard, "owner": owner, "diverted": shard != owner,
+            "hops": hops, "reason": None, "epoch": int(epoch)}
+
+
+def _offroster_route(tenant, key, members, unhealthy=(), epoch=0):
+    """Names a shard that is not in the roster."""
+    out = F.route_decision(tenant, key, members, unhealthy, epoch)
+    if out["shard"] is not None:
+        return dict(out, shard="zz", owner="zz")
+    return out
+
+
+def _silent_divert_route(tenant, key, members, unhealthy=(), epoch=0):
+    """Diverts off a sick owner WITHOUT the diverted flag / hop count
+    — the silent diversion the named-decision rule forbids."""
+    out = F.route_decision(tenant, key, members, unhealthy, epoch)
+    if out["shard"] is not None and out["diverted"]:
+        return dict(out, diverted=False, hops=0)
+    return out
+
+
+def _router_machine(**kw):
+    return M.RouterMachine(member_ids=("p0", "p2"), **kw)
+
+
 #: invariant id -> machine factory with the broken seam injected.
 BROKEN_FIXTURES = {
     "breaker-half-open-one-probe":
@@ -615,12 +684,23 @@ BROKEN_FIXTURES = {
         lambda: _block_machine(decide=_flappy_block_decide),
     "retune-visibility":
         lambda: _block_machine(emit=_stale_block_emit),
+    "placement-deterministic":
+        lambda: _router_machine(route=_FlipRoute()),
+    # mod-N reshuffling only shows between SURVIVORS, so a 3-member
+    # alphabet (a 2-member roster's departure leaves nothing to
+    # reshuffle between)
+    "minimal-reshuffle": lambda: M.RouterMachine(
+        member_ids=("p0", "p2", "p10"), route=_modulo_route),
+    "routes-to-members":
+        lambda: _router_machine(route=_offroster_route),
+    "diversion-named":
+        lambda: _router_machine(route=_silent_divert_route),
 }
 
 
 def test_fixture_table_covers_every_declared_invariant():
     declared = set()
-    for mod in (D, E, A, C, B, R, BT):
+    for mod in (D, E, A, C, B, R, BT, F):
         declared |= {row[0] for row in mod.MODEL_INVARIANTS}
     assert set(BROKEN_FIXTURES) == declared
 

@@ -1,0 +1,555 @@
+"""Cluster-scale serving fabric (serve/fabric.py): pure consistent-hash
+placement properties (determinism, minimal reshuffle, member-only
+targets, named diversion), the recording ShardRouter and its replayable
+``route`` decisions (spill -> `ckreplay verify` exit 0), in-process
+``ServeFabric`` preemption re-routes over the ``autostart=False`` seam,
+warm-on-join, merged shard serving stats, typed ``ServeRejected``
+propagation over the cluster TCP path, and the seeded 3-process
+kill-and-reroute drill over ``tests/_fabric_worker.py``.
+
+The workload kernel adds exactly 1.0f — small-integer f32 arithmetic is
+exact, so every lost, double-applied, or mis-routed request shows as an
+integer-sized error and the assertions demand bit equality (the
+test_serve.py discipline, applied across shards and processes)."""
+
+import importlib.util
+import json
+import os
+import random
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from cekirdekler_tpu import ClArray
+from cekirdekler_tpu.cluster import CruncherClient, CruncherServer
+from cekirdekler_tpu.cluster import server as server_mod
+from cekirdekler_tpu.cluster.elastic import Membership
+from cekirdekler_tpu.core import NumberCruncher
+from cekirdekler_tpu.errors import CekirdeklerError
+from cekirdekler_tpu.hardware import platforms
+from cekirdekler_tpu.metrics.registry import REGISTRY
+from cekirdekler_tpu.obs import replay as replay_mod
+from cekirdekler_tpu.obs.decisions import DecisionLog
+from cekirdekler_tpu.serve import ServeJob, ServeRejected
+from cekirdekler_tpu.serve import fabric as fabric_mod
+from cekirdekler_tpu.serve.fabric import (
+    REJECT_SHARD,
+    VNODES,
+    ServeFabric,
+    ShardRouter,
+    fabric_key,
+    merge_shard_serving,
+    ring_points,
+    route_decision,
+    shard_health,
+)
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+ROOT = os.path.dirname(HERE)
+
+INC = """
+__kernel void inc(__global float* a) {
+    int i = get_global_id(0);
+    a[i] = a[i] + 1.0f;
+}
+"""
+
+
+def _load_tool(name, relpath):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(ROOT, relpath))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+ckreplay = _load_tool("ck_replay_tool_fabric", "tools/ckreplay.py")
+
+
+@pytest.fixture(scope="module")
+def devs():
+    return platforms().cpus()
+
+
+def _keys(count):
+    return [(f"t{i % 5}", f"cid{9100 + i % 7}|inc|4096x64+0#{i}")
+            for i in range(count)]
+
+
+# ---------------------------------------------------------------------------
+# pure placement properties
+# ---------------------------------------------------------------------------
+
+def test_route_decision_deterministic_over_random_rosters():
+    """The same (tenant, key, roster, health, epoch) always yields the
+    bit-identical verdict — regardless of roster ordering or set/list
+    input shape (the replay oracle's precondition)."""
+    rng = random.Random(2017)
+    for _ in range(25):
+        roster = [f"p{rng.randrange(40)}" for _ in range(rng.randrange(1, 7))]
+        bad = tuple(m for m in set(roster) if rng.random() < 0.3)
+        for tenant, key in _keys(8):
+            a = route_decision(tenant, key, roster, bad, epoch=3)
+            b = route_decision(tenant, key, list(reversed(roster)),
+                               tuple(reversed(bad)), epoch=3)
+            assert a == b
+            if a["shard"] is not None:
+                assert a["shard"] in set(roster)
+                assert a["shard"] not in set(bad)
+                assert a["reason"] is None
+            else:
+                assert a["reason"] == REJECT_SHARD
+
+
+def test_route_minimal_reshuffle_on_leave_and_join():
+    """Consistent hashing's promise, checked: a departure moves ONLY
+    the departed member's keys (every other key keeps its owner
+    bit-identically), and a join moves keys ONLY onto the joiner."""
+    rng = random.Random(7)
+    for _ in range(10):
+        roster = sorted({f"p{rng.randrange(30)}"
+                         for _ in range(rng.randrange(3, 7))})
+        keys = _keys(60)
+        before = {k: route_decision(t, k, roster)["shard"]
+                  for t, k in keys}
+        gone = rng.choice(roster)
+        survivors = [m for m in roster if m != gone]
+        for t, k in keys:
+            after = route_decision(t, k, survivors)["shard"]
+            if before[k] != gone:
+                assert after == before[k], "untouched key reshuffled"
+        joiner = "zz-new"
+        grown = roster + [joiner]
+        for t, k in keys:
+            after = route_decision(t, k, grown)["shard"]
+            assert after in (before[k], joiner), \
+                "join moved a key between incumbents"
+
+
+def test_route_unhealthy_diversion_is_named_and_lands_on_successor():
+    roster = ["p0", "p1", "p2", "p3"]
+    for tenant, key in _keys(24):
+        owner = route_decision(tenant, key, roster)["owner"]
+        d = route_decision(tenant, key, roster, (owner,))
+        assert d["diverted"] and d["hops"] >= 1
+        assert d["shard"] != owner and d["shard"] in roster
+        assert d["owner"] == owner  # the ring owner stays on record
+        refused = route_decision(tenant, key, roster, tuple(roster))
+        assert refused["shard"] is None
+        assert refused["reason"] == REJECT_SHARD
+    assert route_decision("t0", "k", [])["reason"] == REJECT_SHARD
+
+
+def test_ring_points_and_fabric_key_are_portable():
+    pts = ring_points(["b", "a"])
+    assert pts == sorted(pts) and len(pts) == 2 * VNODES
+    assert pts == ring_points(("a", "b"))  # input shape-independent
+    a1 = ClArray(np.zeros(256, np.float32), name="one")
+    a2 = ClArray(np.zeros(256, np.float32), name="two")
+    j1 = ServeJob(params=[a1], kernels=["inc"], compute_id=9100,
+                  global_range=256, local_range=64)
+    j2 = ServeJob(params=[a2], kernels=["inc"], compute_id=9100,
+                  global_range=256, local_range=64)
+    # different array OBJECTS, same logical job: identical routing key
+    # (coalescing still keys on the identity-bearing signature)
+    assert fabric_key(j1) == fabric_key(j2) == "cid9100|inc|256x64+0"
+    assert j1.signature() != j2.signature()
+
+
+def test_shard_health_reasons_in_check_order():
+    assert shard_health({})["healthy"]
+    doc = {"resilience": {"dead": True, "breakers_open": 2,
+                          "brownout": {"active": True}},
+           "admission": {"healthy": False}}
+    assert shard_health(doc)["reasons"] == [
+        "dispatcher-dead", "circuit-open", "brownout", "drain-degraded"]
+    assert not shard_health({"admission": {"healthy": False}})["healthy"]
+
+
+def test_merge_shard_serving_sums_the_fleet():
+    merged = merge_shard_serving({
+        "p1": {"queue_depth": 3, "batches": 10, "requests_done": 40,
+               "rounds": 10, "resilience": {"breakers_open": 1}},
+        "p0": {"queue_depth": 1, "batches": 4, "requests_done": 16,
+               "rounds": 4,
+               "resilience": {"dead": True, "brownout": {"active": True}}},
+    })
+    assert merged["shards"] == ["p0", "p1"]
+    assert merged["queue_depth"] == 4 and merged["requests_done"] == 56
+    assert merged["breakers_open"] == 1
+    assert merged["brownouts_active"] == 1 and merged["dead"] == ["p0"]
+
+
+# ---------------------------------------------------------------------------
+# recording router: the replayable `route` decision
+# ---------------------------------------------------------------------------
+
+def test_shard_router_records_replayable_routes(monkeypatch):
+    log = DecisionLog(capacity=512)
+    monkeypatch.setattr(fabric_mod, "DECISIONS", log)
+    ms = Membership()
+    ms.establish({"p0": 2, "p1": 2, "p2": 2})
+    router = ShardRouter(ms)
+    router.mark("p1", ("circuit-open",))
+    outs = [router.route(t, k) for t, k in _keys(12)]
+    rows = [r for r in log.snapshot() if r.kind == "route"]
+    assert len(rows) == 12
+    for r, out in zip(rows, outs):
+        assert r.outputs == out
+        assert r.inputs["members"] == ["p0", "p1", "p2"]
+        assert r.inputs["unhealthy"] == ["p1"]
+        assert r.inputs["epoch"] == 1
+        v = replay_mod.replay_record(r)
+        assert v["ok"], v
+    verdict = replay_mod.verify_records(rows)
+    assert verdict["ok"] and verdict["replayed"] == 12
+
+
+def test_shard_router_health_refresh_replaces_wholesale():
+    ms = Membership()
+    ms.establish({"p0": 1, "p1": 1})
+    router = ShardRouter(ms)
+    router.mark("p0")
+    assert "p0" in router.health_view()
+    bad = router.refresh_health({
+        "p0": {"resilience": {}},
+        "p1": {"resilience": {"breakers_open": 1}},
+    })
+    assert bad == {"p1": ["circuit-open"]}
+    assert router.health_view() == {"p1": ["circuit-open"]}
+    router.clear("p1")
+    assert router.health_view() == {}
+
+
+# ---------------------------------------------------------------------------
+# in-process ServeFabric: exactness, preemption re-route, warm-on-join
+# ---------------------------------------------------------------------------
+
+def _mk_fabric(devs, members=("m0", "m1", "m2"), n=2048, **kw):
+    crunchers = {m: NumberCruncher(devs.subset(1), INC) for m in members}
+    fab = ServeFabric(crunchers, autostart=False, gather_window_s=0.0,
+                      max_batch=64, **kw)
+    a = ClArray(np.zeros(n, np.float32), name="fab")
+    a.partial_read = True
+    job = ServeJob(params=[a], kernels=["inc"], compute_id=9100,
+                   global_range=n, local_range=64)
+    return fab, a, job
+
+
+def _drain(fab, futs, steps=40):
+    done = []
+    for _ in range(steps):
+        fab.step()
+        done = [f for f in futs if f.done()]
+        if len(done) == len(futs):
+            break
+    return done
+
+
+def test_fabric_routes_submits_and_computes_bit_exactly(devs):
+    fab, a, job = _mk_fabric(devs)
+    try:
+        owner = route_decision("t0", fabric_key(job),
+                               fab.shards.keys())["shard"]
+        futs = [fab.submit("t0", job) for _ in range(6)]
+        assert len(_drain(fab, futs)) == 6
+        for f in futs:
+            assert f.exception() is None
+        assert np.all(np.asarray(a) == 6.0)
+        st = fab.stats()
+        assert st["merged"]["requests_done"] == 6
+        # single signature -> exactly one shard (the ring owner) did
+        # all the work; the others stayed idle
+        assert st["shards"][owner]["requests_done"] == 6
+        assert sum(doc["requests_done"]
+                   for doc in st["shards"].values()) == 6
+    finally:
+        fab.close()
+
+
+def test_fabric_preemption_reroutes_bit_exact_and_replays(
+        devs, tmp_path, monkeypatch):
+    """The acceptance drill, in-process and fully deterministic over
+    the ``autostart=False`` seam: queue work on the ring owner, kill
+    that member with the work still queued, and the outer futures
+    re-route the named clean failures onto survivors — every request
+    applies exactly once (bit-exact array), zero hung futures, and the
+    spilled route + member-leave + retry decision log replays green
+    through ``ckreplay verify``."""
+    log = DecisionLog(capacity=2048)
+    monkeypatch.setattr(fabric_mod, "DECISIONS", log)
+    import cekirdekler_tpu.cluster.elastic as elastic_mod
+    monkeypatch.setattr(elastic_mod, "DECISIONS", log)
+    fab, a, job = _mk_fabric(devs)
+    before_reroutes = REGISTRY.counter(
+        "ck_serve_fabric_reroutes_total", "").value
+    try:
+        victim = route_decision("t0", fabric_key(job),
+                                fab.shards.keys())["shard"]
+        futs = [fab.submit("t0", job) for _ in range(8)]
+        # no dispatcher is running: all 8 are still queued on the
+        # victim when the preemption lands
+        fab.remove_member(victim, drain=False)
+        assert victim not in fab.shards
+        done = _drain(fab, futs)
+        assert len(done) == len(futs), "hung futures after preemption"
+        for f in futs:
+            assert f.exception() is None, f.exception()
+        assert np.all(np.asarray(a) == 8.0), "re-route broke exactness"
+        delta = REGISTRY.counter(
+            "ck_serve_fabric_reroutes_total", "").value - before_reroutes
+        assert delta == 8
+        assert fab.membership.snapshot()["epoch"] == 2
+    finally:
+        fab.close()
+    p = str(tmp_path / "fabric_decisions.jsonl")
+    log.save_jsonl(p)
+    kinds = {r.kind for r in log.snapshot()}
+    assert {"route", "member-leave", "retry"} <= kinds
+    assert ckreplay.main(["verify", p]) == 0
+
+
+def test_fabric_warm_on_join_precompiles_observed_signatures(devs):
+    fab, a, job = _mk_fabric(devs, members=("m0", "m1"))
+    try:
+        futs = [fab.submit("t0", job) for _ in range(2)]
+        _drain(fab, futs)
+        before = REGISTRY.counter("ck_serve_warmup_total", "").value
+        fab.add_member("m2", NumberCruncher(devs.subset(1), INC), step=1)
+        assert REGISTRY.counter(
+            "ck_serve_warmup_total", "").value == before + 1
+        assert "m2" in fab.shards and fab.membership.snapshot()["epoch"] == 2
+        # warmup used scratch params: the live array is untouched
+        assert np.all(np.asarray(a) == 2.0)
+        futs = [fab.submit("t1", job) for _ in range(3)]
+        assert len(_drain(fab, futs)) == 3
+        assert np.all(np.asarray(a) == 5.0)
+    finally:
+        fab.close()
+
+
+def test_fabric_no_members_and_closed_refuse_with_named_errors(devs):
+    fab, a, job = _mk_fabric(devs, members=("m0",))
+    try:
+        fab.remove_member("m0")
+        with pytest.raises(ServeRejected) as ei:
+            fab.submit("t0", job)
+        assert ei.value.reason == REJECT_SHARD
+        assert ei.value.retry_after_s > 0
+    finally:
+        fab.close()
+    with pytest.raises(CekirdeklerError, match="is closed"):
+        fab.submit("t0", job)
+
+
+# ---------------------------------------------------------------------------
+# TCP: named rejection reasons survive the wire as the typed error
+# ---------------------------------------------------------------------------
+
+def test_tcp_propagates_typed_serve_rejection(devs, monkeypatch):
+    """A serving-tier rejection raised server-side crosses the cluster
+    TCP path and re-raises client-side as the SAME typed
+    ``ServeRejected`` — named reason, tenant, and retry-after hint
+    intact (not a stringly ``remote error``)."""
+    def _reject(*a, **kw):
+        raise ServeRejected("tenant-9", REJECT_SHARD, 0.125)
+
+    monkeypatch.setattr(server_mod, "NumberCruncher", _reject)
+    server = CruncherServer(devices=devs.subset(1))
+    try:
+        client = CruncherClient(server.host, server.port)
+        try:
+            with pytest.raises(ServeRejected) as ei:
+                client.setup(INC)
+            assert ei.value.reason == REJECT_SHARD
+            assert ei.value.tenant == "tenant-9"
+            assert ei.value.retry_after_s == 0.125
+        finally:
+            client.close()
+    finally:
+        server.stop()
+
+
+def test_tcp_plain_errors_stay_untyped(devs, monkeypatch):
+    """Only structurally-marked rejections get the typed re-raise;
+    any other server-side failure stays the generic named remote
+    error."""
+    def _boom(*a, **kw):
+        raise RuntimeError("kaboom")
+
+    monkeypatch.setattr(server_mod, "NumberCruncher", _boom)
+    server = CruncherServer(devices=devs.subset(1))
+    try:
+        client = CruncherClient(server.host, server.port)
+        try:
+            with pytest.raises(CekirdeklerError, match="remote error"):
+                client.setup(INC)
+        finally:
+            client.close()
+    finally:
+        server.stop()
+
+
+# ---------------------------------------------------------------------------
+# the seeded 3-process kill-and-reroute drill
+# ---------------------------------------------------------------------------
+
+def _spawn_worker(member, n=2048, local_range=64):
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(HERE, "_fabric_worker.py"),
+         member, str(n), str(local_range)],
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL, text=True, env=env, cwd=ROOT)
+    return proc
+
+
+def _await_ready(proc, member, timeout_s=180.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        line = proc.stdout.readline()
+        if not line:
+            raise RuntimeError(f"worker {member} died before READY")
+        if line.startswith("FABRIC_READY"):
+            return
+    raise RuntimeError(f"worker {member} never became READY")
+
+
+def _rpc(proc, cmd):
+    """One JSON round-trip; None on EOF (the killed-member signal)."""
+    try:
+        proc.stdin.write(json.dumps(cmd) + "\n")
+        proc.stdin.flush()
+    except (BrokenPipeError, OSError):
+        return None
+    line = proc.stdout.readline()
+    if not line:
+        return None
+    return json.loads(line)
+
+
+def test_three_process_seeded_kill_and_reroute(devs):
+    """3 real worker processes, a seeded mid-run SIGKILL of one, and
+    the parent re-routing exactly the unacked requests onto the ring
+    survivors: every request completes exactly once (zero hung
+    futures), the only failure mode is the named member death (the
+    victim's EOF), surviving members' placements never move (minimal
+    reshuffle, observed — not just computed), and every survivor's
+    array equals its applied count bit-exactly."""
+    members = ["m0", "m1", "m2"]
+    n, sigs, rids_per_sig = 2048, 3, 4
+    seed = 2017
+    procs = {m: _spawn_worker(m, n=n) for m in members}
+    membership = Membership()
+    membership.establish({m: 1 for m in members})
+    try:
+        ready = [threading.Thread(target=_await_ready,
+                                  args=(procs[m], m)) for m in members]
+        for t in ready:
+            t.start()
+        for t in ready:
+            t.join(timeout=200.0)
+        for m in members:
+            assert procs[m].poll() is None, f"worker {m} did not start"
+
+        # the parent-side routing table: one placement per rid, from
+        # the SAME pure function the fabric runs
+        work = []  # (rid, tenant, si, shard)
+        rid = 0
+        for si in range(sigs):
+            key = f"cid{9100 + si}|lg_inc|{n}x64+0"
+            for j in range(rids_per_sig):
+                tenant = f"t{j % 2}"
+                shard = route_decision(
+                    tenant, key, members,
+                    epoch=membership.snapshot()["epoch"])["shard"]
+                work.append((rid, tenant, si, shard))
+                rid += 1
+        by_shard = {m: [w for w in work if w[3] == m] for m in members}
+        victims = [m for m in members if len(by_shard[m]) >= 2]
+        victim = random.Random(seed).choice(sorted(victims))
+        survivors = [m for m in members if m != victim]
+
+        for m in members:
+            assert _rpc(procs[m], {
+                "op": "warm",
+                "sigs": sorted({w[2] for w in by_shard[m]}) or [0],
+            })["op"] == "warmed"
+
+        acked: dict = {}
+        unacked: list = []
+        failures: list = []
+        kill_at = 1  # SIGKILL after the victim's first ack (seeded run)
+
+        def feed(m):
+            for w in by_shard[m]:
+                r, tenant, si, _ = w
+                reply = _rpc(procs[m], {"op": "run", "rid": r,
+                                        "tenant": tenant, "sig": si,
+                                        "iters": 1})
+                if reply is None:
+                    if m == victim:
+                        unacked.append(w)  # the named member death
+                    else:
+                        failures.append((m, r, "eof"))
+                    continue
+                if reply.get("op") != "done":
+                    failures.append((m, r, reply))
+                    continue
+                acked[r] = m
+                if m == victim and len([v for v in acked.values()
+                                        if v == victim]) == kill_at:
+                    procs[m].kill()
+
+        feeders = [threading.Thread(target=feed, args=(m,))
+                   for m in members]
+        for t in feeders:
+            t.start()
+        for t in feeders:
+            t.join(timeout=240.0)
+        assert not any(t.is_alive() for t in feeders), "hung worker rpc"
+        assert failures == [], failures
+        assert unacked, "the seeded kill landed after the victim drained"
+
+        # the preemption: epoch-bumping leave, then re-route ONLY the
+        # unacked rids over the survivor roster
+        membership.leave(victim)
+        epoch = membership.snapshot()["epoch"]
+        for r, tenant, si, _ in unacked:
+            key = f"cid{9100 + si}|lg_inc|{n}x64+0"
+            d = route_decision(tenant, key, survivors, epoch=epoch)
+            assert d["shard"] in survivors
+            reply = _rpc(procs[d["shard"]], {
+                "op": "run", "rid": r, "tenant": tenant, "sig": si,
+                "iters": 1})
+            assert reply is not None and reply["op"] == "done", reply
+            acked[r] = d["shard"]
+        # minimal reshuffle, observed: survivors' own rids never moved
+        for r, tenant, si, shard in work:
+            if shard != victim:
+                assert acked[r] == shard
+        assert sorted(acked) == [w[0] for w in work], "lost/dup rids"
+
+        # bit-exactness: each survivor's per-sig array equals exactly
+        # the number of requests it applied
+        for m in survivors:
+            applied: dict = {}
+            for r, tenant, si, _ in work:
+                if acked[r] == m:
+                    applied[si] = applied.get(si, 0) + 1
+            for si, count in applied.items():
+                v = _rpc(procs[m], {"op": "value", "sig": si})
+                assert v["uniform"], f"torn array on {m} sig {si}"
+                assert v["value"] == float(count), (m, si, v, count)
+        for m in survivors:
+            assert _rpc(procs[m], {"op": "exit"}) == {"op": "bye"}
+    finally:
+        for proc in procs.values():
+            if proc.poll() is None:
+                proc.kill()
+            proc.wait(timeout=30.0)

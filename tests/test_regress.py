@@ -39,6 +39,7 @@ HEADLINE = {
     "serve_coalesce_ratio": 4.0,
     "serve_chaos_goodput_frac": 0.9,
     "serve_chaos_p99_ms": 60.0,
+    "fabric_chaos_goodput_frac": 0.8,
     "drain_recover_ms": 900.0,
     "rejoin_converge_iters": 4.0,
 }

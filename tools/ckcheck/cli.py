@@ -81,6 +81,14 @@ HOT_ROOTS = (
     # mutex), and decision/flight records emit only on a choice CHANGE
     # behind the recorders' enabled flags
     "core.blocktuner.BlockTuner.choose",
+    # the fabric routing path (ISSUE 17): every cluster request pays
+    # route() + submit() — the pure route_decision core allocates only
+    # small tuples/dicts, metric handles are cached at construction,
+    # diversion flight events and route decision records emit behind
+    # the recorders' enabled flags, and only the router/fabric locks
+    # below may be taken
+    "serve.fabric.ShardRouter.route",
+    "serve.fabric.ServeFabric.submit",
 )
 
 #: Locks the hot path may take: the scheduler lock + fused-window mutex
@@ -112,6 +120,15 @@ HOT_LOCK_ALLOW = (
     # (snapshot walls / apply choice), never held across the store
     # read or the recorders — the TransferTuner discipline
     "core.blocktuner.BlockTuner._mu",
+    # fabric route/submit: one short roster+health snapshot under the
+    # router lock, one in-flight bookkeeping write under the fabric
+    # lock — neither is held across a shard submit or any recorder
+    "serve.fabric.ShardRouter._mu",
+    "serve.fabric.ServeFabric._mu",
+    # retry budgets (reached from the fabric re-route path): a couple
+    # of dict reads/writes per preempted request under one small-state
+    # lock — preemption recovery, not the steady-state submit path
+    "serve.resilience.RetryBudgets._mu",
 )
 
 

@@ -64,6 +64,11 @@ PURE_FUNCTIONS = (
     ("cekirdekler_tpu/core/blocktuner.py",
      ("block_transition", "legal_block_grid", "orient_block_grid",
       "clamp_blocks"), ()),
+    # the fabric router's placement core: sha256 is the one declared
+    # seam (deterministic hash, the consistent-hash ring's substrate)
+    ("cekirdekler_tpu/serve/fabric.py",
+     ("route_decision", "placement_key", "ring_points", "shard_health"),
+     ("sha256",)),
 )
 
 #: Call roots that make a transition replay-inexact by construction.

@@ -117,6 +117,14 @@ WATCHED_KEYS = (
     # sleep-scale injections on a contended CPU container
     ("drain_recover_ms", (), "lower", 0.50),
     ("rejoin_converge_iters", (), "lower", 0.50),
+    # cluster serving fabric (ISSUE 17, bench section "serving_fabric"):
+    # goodput retained when a seeded mid-run member kill re-routes its
+    # in-flight requests onto the surviving shards, vs the kill-free
+    # control (higher is better; exactness-gated to None on any fabric
+    # chaos-contract violation — a hung future or a torn result must
+    # starve the key, never ship a number).  Floor is wide: the whole
+    # run rides thread scheduling on a contended CPU container
+    ("fabric_chaos_goodput_frac", (), "higher", 0.30),
 )
 
 #: Trajectory-noise widening: tolerance = max(floor, NOISE_K * CV).
@@ -143,6 +151,7 @@ KEY_SECTION = {
     "serve_chaos_p99_ms": "serving",
     "drain_recover_ms": "resilience",
     "rejoin_converge_iters": "resilience",
+    "fabric_chaos_goodput_frac": "serving_fabric",
 }
 
 
