@@ -706,7 +706,11 @@ RESERVED_SECTIONS = {"flash_train": 360.0, "marker_overhead": 60.0,
                      # the recovery tier (ISSUE 13): seeded
                      # drain-and-readmit + kill-and-rejoin scenarios
                      # minting drain_recover_ms / rejoin_converge_iters
-                     "resilience": 60.0}
+                     "resilience": 60.0,
+                     # the persistent executable cache (ISSUE 18):
+                     # subprocess cold/populate/warm trio minting the
+                     # regression-watched cold_start_warm_speedup
+                     "cold_start": 60.0}
 
 #: Must-run slice granted to a fairness-rotation promotion (a section
 #: budget-starved 2 rounds running) — big enough for every current
@@ -1138,6 +1142,18 @@ def main() -> None:
     resilience = section(
         "resilience", lambda: _load_resilience().resilience_section(devs))
 
+    # Persistent executable cache (ISSUE 18): subprocess cold/populate/
+    # warm incarnations of the n-body + flash ladders — process-cold vs
+    # cache-warm first-call latency, minting the regression-watched
+    # cold_start_warm_speedup (exactness-gated: the cache must be
+    # bit-invisible).  rejoin_converge_iters rides along in the same
+    # artifact block so the two autoscale numbers read side by side.
+    cold_start = section(
+        "cold_start",
+        lambda: _load_tool("coldstart").coldstart_section(
+            devs,
+            resilience=resilience if isinstance(resilience, dict) else None))
+
     # Balancer on the 8-device rig with skewed per-range load (r2 #4).
     rig = section("balancer_rig", balancer_rig_section)
 
@@ -1222,6 +1238,7 @@ def main() -> None:
         "serving": serving,
         "serving_fabric": serving_fabric,
         "resilience": resilience,
+        "cold_start": cold_start,
         "nbody_note": (
             "nbody_gpairs_per_sec = sync-per-call variant (host fence "
             "every iteration, RTT-bound — a dispatch-latency metric); "
@@ -1372,6 +1389,15 @@ def main() -> None:
             "rejoin_converge_iters": (
                 resilience.get("rejoin_converge_iters")
                 if isinstance(resilience, dict) and resilience.get("exact")
+                else None
+            ),
+            # the persistent executable cache's headline (ISSUE 18):
+            # process-cold / cache-warm first-batch ratio, exactness-
+            # gated — a cache that changes results reports None (the
+            # sentinel treats a null watched key as STARVED)
+            "cold_start_warm_speedup": (
+                cold_start.get("cold_start_warm_speedup")
+                if isinstance(cold_start, dict) and cold_start.get("exact")
                 else None
             ),
             "dtype_cells": (

@@ -446,8 +446,13 @@ class DistributedAccelerator(IComputeNode):
         """Resume a preempted job: load the newest COMPLETE window
         checkpoint (torn newest falls back — utils/checkpoint.py),
         reconcile membership against the checkpointed roster (recorded
-        leave/join re-splits), and return ``{"window", "arrays",
-        "member_steps", "membership"}`` — or None on a fresh start."""
+        leave/join re-splits), warm the local cruncher's ladder set
+        from the persistent executable cache when ``CK_COMPILE_CACHE``
+        is armed (core/compilecache.py — a rejoining member re-traces
+        the fleet's persisted signature mix and every XLA compile loads
+        from disk, so the rejoin pays no fresh compile wall), and
+        return ``{"window", "arrays", "member_steps", "membership"}``
+        — or None on a fresh start."""
         from .elastic import resume_window
 
         state = resume_window(root)
@@ -455,6 +460,13 @@ class DistributedAccelerator(IComputeNode):
             local_range,
             prev_steps=(state or {}).get("member_steps"),
             total=total)
+        if self.cruncher is not None:
+            from ..core.compilecache import CACHE, warm_from_disk
+
+            if CACHE.enabled:
+                warm = warm_from_disk(self.cruncher.cores)
+                if state is not None:
+                    state["cache_warm"] = warm
         if state is None:
             return None
         state["membership"] = membership

@@ -318,6 +318,20 @@ class BlockTuner:
                                        fallback=fallback)
         return pair
 
+    def prewarm(self, kernel_sig, tq: int, tk: int, shape=None,
+                fallback=None):
+        """AOT-warmup seam (core/compilecache.py, tools/coldstart.py):
+        engage this shape's choice BEFORE its first live call, so the
+        executable the warmup path compiles — and the persistent cache
+        stores — is the TUNED block geometry, not the static fallback a
+        cold tuner would hand the first caller.  The ProfileStore is
+        file-backed, so a warm-from-disk process re-engages the SAME
+        pair the populating process measured (same blocks → same Pallas
+        executable → XLA persistent-cache hit).  Returns the engaged
+        pair (None: caller warms the dense path)."""
+        return self.choose(kernel_sig, tq, tk, shape=shape,
+                           fallback=fallback)
+
     def _choose_full(self, kernel_sig, tq: int, tk: int, shape=None,
                      fallback=None):
         tq, tk = int(tq), int(tk)

@@ -60,6 +60,7 @@ from .balance import (
     load_balance,
     per_iteration_benches,
 )
+from .compilecache import CACHE as COMPILE_CACHE
 from .stream import TransferTuner, chunk_plan
 from .worker import Worker
 
@@ -144,6 +145,13 @@ class Cores:
         devices.require_nonempty("Cores device selection")
         self.devices = devices
         self.program = program
+        # persistent executable cache (core/compilecache.py): arming at
+        # construction — not lazily at first engage — means EVERY compile
+        # in an armed process lands in the XLA disk cache, including the
+        # per-call launchers a window's first 1-2 iterations ride before
+        # fused engagement.  No-op unless CK_COMPILE_CACHE is set.
+        if COMPILE_CACHE.enabled:
+            COMPILE_CACHE.arm()
         self.workers = [Worker(d.jax_device, i) for i, d in enumerate(devices)]
         self.pool = ThreadPoolExecutor(max_workers=max(1, len(self.workers)))
         # per-compute-id state (reference: Cores.cs:130-135)
@@ -938,6 +946,13 @@ class Cores:
             self._fused_sig = sig
             self._fused_run = run
         FLIGHT.event("fused-engage", cid=compute_id, rows=len(rows))
+        # persistent-cache seam (core/compilecache.py): an engaged
+        # window's spec is what a joining process would need to warm —
+        # persist it here (engagement is cold: once per window open,
+        # never the defer path; the cache's seen-set bounds the probe
+        # to one per distinct key per process)
+        if COMPILE_CACHE.enabled:
+            self._cache_record_engaged(run)
         if DECISIONS.enabled:
             # provenance (not replayable: the engage check reads LIVE
             # device residency) — what signature fused, on which lanes
@@ -1265,6 +1280,193 @@ class Cores:
             "ladder_iters": ladder,
             "per_call_iters": iters - ladder,
         }
+
+    # -- AOT warmup / persistent executable cache (ROADMAP item 4) -----------
+    def _warm_targets(self) -> list:
+        """Distinct (platform, donate, device_kind, device) combinations
+        across this scheduler's lanes — the set of fused-launcher key
+        variants the live path can request.  ``donate`` is computed
+        EXACTLY as ``Worker.launch_fused`` computes it: a warmed key
+        that differs in any component is a silent no-op (the satellite-1
+        bug this method exists to prevent)."""
+        seen: dict = {}
+        for w in self.workers:
+            platform = w.device.platform
+            donate = platform == "tpu" and not w.track_cid_outputs
+            kind = str(getattr(w.device, "device_kind", platform))
+            seen.setdefault((platform, donate, kind), w.device)
+        return [(p, d, k, dev) for (p, d, k), dev in seen.items()]
+
+    def warmup(self, plan) -> dict:
+        """AOT-precompile a workload plan's full predicated launch
+        ladders BEFORE traffic arrives (the first-class warmup path —
+        ``ServeFrontend.warmup``, the fabric's warm-on-join, and the
+        elastic rejoin all route here).
+
+        ``plan`` is an iterable of :class:`~.compilecache.WarmupSpec`
+        (or anything with the job surface ``kernels/params/global_range/
+        local_range/values`` — e.g. ``serve.ServeJob``; live params are
+        read for size/dtype only, NEVER executed against).  Per distinct
+        spec, per distinct lane (platform, donate) variant, this builds
+        and EXECUTES on scratch buffers:
+
+        - the fused predicated-ladder executable under the EXACT key the
+          live fused window requests (``KernelProgram.fused_launcher``
+          9-tuple — executing it also fills jax's in-process dispatch
+          cache, so the first live call is a cache hit end to end), and
+        - every per-call chunk launcher ``step·2^k`` up to the global
+          range (any balancer split's per-lane ladder is a subset).
+
+        With ``CK_COMPILE_CACHE`` armed, each spec's ladder key is
+        looked up in the on-disk manifest (hit/miss counted +
+        ``ck_compile_cache_*`` metrics), misses are persisted for other
+        processes, and the XLA compiles triggered here are served from /
+        written to JAX's persistent compilation cache — a joining shard
+        warms from disk instead of recompiling.  Unarmed, the disk layer
+        is skipped entirely and results stay bit-identical.
+
+        Emits one ``cache-warmup`` flight event + context decision per
+        plan (key set, hit/miss split, wall).  Returns ``{"warmed",
+        "hits", "misses", "skipped", "wall_s"}``."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from .compilecache import CACHE, WarmupSpec
+
+        t0 = time.perf_counter()
+        if CACHE.enabled:
+            CACHE.arm()
+        specs: list = []
+        seen_specs: set = set()
+        skipped = 0
+        for item in plan:
+            if isinstance(item, WarmupSpec):
+                spec = item
+            else:
+                try:
+                    spec = WarmupSpec.from_job(
+                        item.kernels, item.params,
+                        getattr(item, "compute_id", 0), item.global_range,
+                        item.local_range,
+                        getattr(item, "global_offset", 0),
+                        getattr(item, "values", ()),
+                    )
+                except Exception:  # noqa: BLE001 - unwarmable job shape
+                    skipped += 1
+                    continue
+            ident = (spec.kernels, spec.params, spec.global_range,
+                     spec.local_range, spec.values)
+            if ident in seen_specs:
+                continue
+            seen_specs.add(ident)
+            if (spec.local_range <= 0
+                    or spec.global_range % spec.local_range != 0
+                    or not all(n in self.program for n in spec.kernels)):
+                skipped += 1
+                continue
+            specs.append(spec)
+
+        hits = misses = 0
+        keys: list[str] = []
+        for spec in specs:
+            step = spec.local_range
+            units = spec.global_range // step
+            vals = spec.value_args()
+
+            def vals_for(name, _v=vals):
+                if isinstance(_v, dict):
+                    return tuple(_v.get(name, ()))
+                return tuple(_v)
+
+            for platform, donate, device_kind, device in self._warm_targets():
+                key = None
+                hit = False
+                if CACHE.enabled:
+                    key = CACHE.ladder_key(
+                        self.program, spec, platform, donate, device_kind)
+                    keys.append(key)
+                    hit = CACHE.lookup(key)
+                bufs = tuple(
+                    jax.device_put(jnp.zeros(n, dtype=np.dtype(d)), device)
+                    for n, d in spec.params
+                )
+                # the fused predicated ladder, under the live path's key
+                fn = self.program.fused_launcher(
+                    tuple(spec.kernels), step, spec.global_range,
+                    spec.local_range, spec.global_range, vals,
+                    platform=platform, donate=donate,
+                )
+                if fn is not None:
+                    out = fn(0, units, 1, bufs)
+                    jax.block_until_ready(out)
+                    bufs = tuple(out)  # donate consumed the scratch set
+                # every per-call chunk the binary ladder can emit
+                nbits = max(1, units.bit_length())
+                for name in dict.fromkeys(spec.kernels):
+                    n_arr = self.program.array_param_count(name)
+                    va = vals_for(name)
+                    for k in range(nbits):
+                        chunk = step << k
+                        if chunk > spec.global_range:
+                            break
+                        try:
+                            f2, _info = self.program.launcher(
+                                name, chunk, spec.local_range,
+                                spec.global_range, platform)
+                            jax.block_until_ready(
+                                f2(0, bufs[:n_arr], va))
+                        except TypeError:
+                            break  # unhashable static values: skip name
+                if CACHE.enabled:
+                    if hit:
+                        hits += 1
+                    else:
+                        misses += 1
+                        CACHE.record(key, spec, platform, donate,
+                                     device_kind)
+        wall_s = time.perf_counter() - t0
+        FLIGHT.event(
+            "cache-warmup", warmed=len(specs), hits=hits, misses=misses,
+            skipped=skipped, wall_ms=round(wall_s * 1e3, 3),
+            cache=CACHE.enabled,
+        )
+        if DECISIONS.enabled:
+            # context record (reads the filesystem: provenance, not
+            # oracle) — which keys this plan warmed, from which split
+            DECISIONS.record("cache-warmup", {
+                "specs": [s.to_payload() for s in specs],
+                "cache_enabled": CACHE.enabled,
+                "cache_root": CACHE.root,
+            }, {
+                "warmed": len(specs), "hits": hits, "misses": misses,
+                "skipped": skipped, "keys": keys,
+                "wall_ms": round(wall_s * 1e3, 3),
+            })
+        return {"warmed": len(specs), "hits": hits, "misses": misses,
+                "skipped": skipped, "wall_s": wall_s}
+
+    def _cache_record_engaged(self, run: _FusedRun) -> None:
+        """Persist an engaged window's ladder spec so OTHER processes
+        can warm it from disk (the fleet's live signature mix IS the
+        cache's content).  Cold path — once per distinct key per
+        process (the ``_seen`` set bounds disk probes); best-effort and
+        torn-tolerant like every cache write."""
+        from .compilecache import CACHE, WarmupSpec
+
+        try:
+            spec = WarmupSpec.from_job(
+                run.kernel_names, run.params, run.compute_id,
+                run.global_range, run.local_range, 0, run.value_args)
+            for platform, donate, device_kind, _dev in self._warm_targets():
+                key = CACHE.ladder_key(
+                    self.program, spec, platform, donate, device_kind)
+                if key in CACHE._seen:
+                    continue
+                if not CACHE.lookup(key, count=False):
+                    CACHE.record(key, spec, platform, donate, device_kind)
+        except Exception:  # noqa: BLE001 - cache is never load-bearing
+            pass
 
     def _fused_drain(self) -> None:
         errs: list[Exception] = []

@@ -149,7 +149,12 @@ def plan_signature(plan) -> str:
     ``blocks`` component of kernel-profile store keys
     (``trace/device.ProfileStore``) — the same kernel at two chunk
     geometries is two different device-time stories, and launch marks
-    correlated per geometry must never collide in the store."""
+    correlated per geometry must never collide in the store.  It is
+    also the ladder-geometry component of the persistent executable
+    cache's cross-process key (``core/compilecache.CompileCache
+    .ladder_key``) — ONE canonical geometry string on purpose: a
+    second spelling would let a profile row and a cached executable
+    describe "the same" ladder under different keys."""
     sizes = [
         int(p[1]) if isinstance(p, (tuple, list)) else int(p) for p in plan
     ]

@@ -92,6 +92,16 @@ def _ladder(size: int, step: int) -> list[int]:
     return out
 
 
+def launch_ladder(size: int, step: int) -> list[int]:
+    """The ladder-build seam: the ONE decomposition every launch-geometry
+    consumer shares — per-call dispatch (:meth:`Worker.launch`), the
+    streamed chunk planner (``core/stream.chunk_plan``), and the
+    persistent executable cache's key/warmup geometry
+    (``core/compilecache``).  A second decomposition would silently warm
+    and key executables the live path never launches."""
+    return _ladder(size, step)
+
+
 class _DriverQueue:
     """Depth-limited per-device dispatch driver (the fused-iteration
     path's host-side queue, core/cores.py): ONE daemon thread per chip

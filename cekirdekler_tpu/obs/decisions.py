@@ -118,6 +118,7 @@ DECISION_KINDS = (
     "checkpoint-restore",  # cluster/elastic — a run resumed from a window ckpt
     "block-retune",        # core/blocktuner — tile/block choice engaged/moved
     "route",               # serve/fabric — one shard-placement verdict
+    "cache-warmup",        # core/cores.warmup — one AOT plan warmed (key set)
 )
 
 #: The subset replay-verify re-executes: decisions that are pure
@@ -145,6 +146,7 @@ CONTEXT_KINDS = (
     "drain-advisory",      # derived view of the monitor's verdicts
     "scheduler-rotation",  # derived from on-disk artifact history
     "checkpoint-restore",  # reads the filesystem: provenance, not oracle
+    "cache-warmup",        # reads the cache manifest: provenance, not oracle
 )
 
 #: Spill-buffer bound: the armed jsonl accumulation is capped so a

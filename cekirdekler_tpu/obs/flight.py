@@ -97,6 +97,7 @@ EVENT_KINDS = (
     "member-leave", "member-join",
     "checkpoint-restore", "checkpoint-fallback", "checkpoint-sweep",
     "fabric-divert", "fabric-reroute", "fabric-warm",
+    "cache-warmup",
 )
 
 #: Postmortem JSON schema tag.  v2 (this revision) embeds the decision

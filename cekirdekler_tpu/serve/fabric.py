@@ -585,12 +585,15 @@ class ServeFabric:
     def add_member(self, member: str, cruncher, step: int = 1,
                    total: int | None = None, warm: bool | None = None
                    ) -> dict:
-        """A member joined (rejoin, scale-up): build its frontend,
-        WARM it from the fleet's observed group table (scratch params
-        — compile hits are shape-only, so precompiling with zero
-        arrays of the right shape/dtype never touches live data),
-        and only then record the ``member-join`` that makes it
-        routable."""
+        """A member joined (rejoin, scale-up): build its frontend, WARM
+        it from the fleet's observed group table via the AOT path
+        (``ServeFrontend.warmup`` → ``Cores.warmup`` precompiles on
+        scratch device buffers — live jobs are read for shapes only,
+        never executed against), plus — when ``CK_COMPILE_CACHE`` is
+        armed — from the on-disk cross-process cache, so a joining
+        shard whose signature mix other processes already persisted
+        performs ZERO fresh ladder compiles.  Only then record the
+        ``member-join`` that makes it routable."""
         member = str(member)
         fe = ServeFrontend(
             cruncher, name=f"{self.name}-{member}",
@@ -599,39 +602,27 @@ class ServeFabric:
         if do_warm:
             with self._mu:
                 jobs = list(self._observed.values())
-            scratch = [j for j in (self._scratch_job(j0) for j0 in jobs)
-                       if j is not None]
-            if scratch:
-                warmed = fe.warmup(scratch)
-                FLIGHT.event("fabric-warm", member=member,
-                             signatures=warmed["warmed"])
+            warmed = {"warmed": 0, "hits": 0, "misses": 0}
+            if jobs:
+                warmed = fe.warmup(jobs)
+            # the persisted fleet mix may be wider than THIS process's
+            # observed table (other processes' windows) — warm it too
+            from ..core.compilecache import CACHE, warm_from_disk
+
+            if CACHE.enabled:
+                disk = warm_from_disk(fe.cores)
+                warmed["hits"] = warmed.get("hits", 0) + disk["hits"]
+                warmed["misses"] = warmed.get("misses", 0) + disk["misses"]
+            FLIGHT.event("fabric-warm", member=member,
+                         signatures=warmed["warmed"],
+                         cache_hits=warmed.get("hits", 0),
+                         cache_misses=warmed.get("misses", 0))
         with self._mu:
             self.shards[member] = fe
         self._g_shards.set(float(len(self.shards)))
         out = self.membership.join(member, step, total)
         self.router.clear(member)
         return out
-
-    @staticmethod
-    def _scratch_job(jb: ServeJob) -> ServeJob | None:
-        """A shape-identical job over FRESH zero arrays — the warmup
-        vehicle (the executable cache keys on shape, not identity, so
-        this compiles the real job's ladder without mutating its
-        arrays).  Params that cannot be cloned generically (no
-        size/dtype surface) skip warmup rather than fail the join."""
-        from ..arrays.clarray import ClArray
-
-        try:
-            params = [ClArray(int(p.size), dtype=p.dtype,
-                              name=f"warm-{getattr(p, 'name', i)}")
-                      for i, p in enumerate(jb.params)]
-        except Exception:  # noqa: BLE001 - warmup is best-effort
-            return None
-        return ServeJob(
-            params=params, kernels=tuple(jb.kernels),
-            compute_id=jb.compute_id, global_range=jb.global_range,
-            local_range=jb.local_range, global_offset=jb.global_offset,
-            values=jb.values)
 
     def sync_alive(self, root: str, timeout_s: float,
                    total: int | None = None) -> list:

@@ -908,38 +908,30 @@ class ServeFrontend:
         return out
 
     def warmup(self, jobs) -> dict:
-        """Precompile the ladder set for a list of jobs (cold-start
-        warmup — ROADMAP item 4's minimal slice; the full on-disk
-        compile cache stays future work): each DISTINCT signature
-        dispatches one single-iteration fused batch through the same
-        ``compute_fused_batch`` path a coalesced batch rides, so the
-        shape-only executable cache turns every later batch into a
-        compile hit.  The warm iteration EXECUTES — it mutates the
-        given jobs' arrays — so callers warm with scratch params of
-        the production shapes (``ServeFabric`` does; shapes are the
-        cache key, identities are not).  Counted via
-        ``ck_serve_warmup_total``; returns ``{"warmed": n}``."""
-        seen: set = set()
-        warmed = 0
+        """AOT-precompile the ladder set for a list of jobs (cold-start
+        warmup — ROADMAP item 4): routes through ``Cores.warmup``, which
+        builds and executes, on SCRATCH device buffers, the fused
+        predicated-ladder executable under the EXACT key the live
+        fused-window path requests (kernel sequence, step, range
+        geometry, baked values, platform, donation — a key mismatch
+        would make warmup a silent no-op, pinned by test) plus every
+        per-call chunk launcher the binary ladder can emit.  The given
+        jobs are read for shapes/dtypes only and NEVER executed against
+        — live params are safe to pass directly.  With
+        ``CK_COMPILE_CACHE`` armed, warmed ladders persist to (and load
+        from) the on-disk cross-process cache (core/compilecache.py).
+        Counted via ``ck_serve_warmup_total`` per distinct warmed
+        shape; returns ``{"warmed", "hits", "misses", "skipped",
+        "wall_s"}``."""
+        specs = []
         for job in jobs:
             jb = job if isinstance(job, ServeJob) else ServeJob(**job)
-            sig = jb.signature()
-            if sig in seen:
-                continue
-            seen.add(sig)
-            with self._step_mu:
-                if not self.cores.enqueue_mode:
-                    self.cores.enqueue_mode = True
-                self.cores.compute_fused_batch(
-                    list(jb.kernels), list(jb.params), jb.compute_id,
-                    jb.global_range, jb.local_range, 1,
-                    global_offset=jb.global_offset,
-                    value_args=jb.values)
-                self.cores.barrier()
-                self.cores.flush()
-            self._m_warmups.inc()
-            warmed += 1
-        return {"warmed": warmed}
+            specs.append(jb)
+        out = self.cores.warmup(specs)
+        warmed = int(out.get("warmed", 0))
+        if warmed:
+            self._m_warmups.inc(warmed)
+        return out
 
     # -- views / lifecycle ---------------------------------------------------
     def stats(self) -> dict:

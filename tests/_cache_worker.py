@@ -1,0 +1,106 @@
+"""Worker process for the persistent executable cache: one cold
+interpreter over its own ``NumberCruncher`` (the ``tests/_dcn_worker.py``
+JSON-lines idiom: the parent spawns it, reads a READY sentinel, then
+drives a one-JSON-object-per-line command protocol on stdin/stdout).
+Used by ``tests/test_compilecache.py`` — process A populates the cache
+through the LIVE engage-time recorder, process B starts cold, replays
+``warm_from_disk`` and proves its first live batch compiles nothing.
+
+``CK_COMPILE_CACHE`` comes from the parent's env (that is the product
+seam under test — no flag shadowing it).
+
+Protocol (every command gets one reply):
+
+- ``{"op": "warm_disk"}`` — ``warm_from_disk(cores)`` →
+  ``{"op": "warmed", "warmed", "hits", "misses", "skipped"}``
+- ``{"op": "batch", "n", "lr", "iters", "scale"}`` — one live
+  ``compute_fused_batch`` of the ``scl`` kernel (baked float value →
+  the JSON value-roundtrip is on the key path) →
+  ``{"op": "done", "fused_compiles", "call_compiles", "value",
+  "uniform"}`` — the compile counters are the DELTA this batch caused
+- ``{"op": "stats"}`` — the cache ``stats()`` doc (empty when
+  disarmed) → ``{"op": "stats", "stats": {...}}``
+- ``{"op": "exit"}`` → ``{"op": "bye"}`` and a clean close.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+SRC = """
+__kernel void scl(__global float* a, float s) {
+    int i = get_global_id(0);
+    a[i] = a[i] + s;
+}
+"""
+
+CID = 7100
+
+
+def main() -> None:
+    import numpy as np
+
+    from cekirdekler_tpu import ClArray
+    from cekirdekler_tpu.core.compilecache import CACHE, warm_from_disk
+    from cekirdekler_tpu.core.cruncher import NumberCruncher
+    from cekirdekler_tpu.hardware import all_devices
+
+    devs = all_devices().cpus().subset(1)
+    cr = NumberCruncher(devs, SRC)
+    cores = cr.cores
+    arrays: dict = {}
+    print(json.dumps({"op": "ready", "cache": CACHE.enabled,
+                      "pid": os.getpid()}), flush=True)
+    for line in sys.stdin:
+        line = line.strip()
+        if not line:
+            continue
+        cmd = json.loads(line)
+        op = cmd.get("op")
+        if op == "exit":
+            print(json.dumps({"op": "bye"}), flush=True)
+            break
+        elif op == "warm_disk":
+            out = warm_from_disk(cores)
+            print(json.dumps({"op": "warmed", **out}), flush=True)
+        elif op == "batch":
+            n, lr = int(cmd["n"]), int(cmd["lr"])
+            iters = int(cmd.get("iters", 3))
+            scale = float(cmd.get("scale", 1.0))
+            if n not in arrays:
+                a = ClArray(np.zeros(n, np.float32), name=f"a{n}")
+                a.partial_read = True
+                arrays[n] = a
+            a = arrays[n]
+            before = (cores.program.fused_compiled_count,
+                      cores.program.compiled_count)
+            cr.enqueue_mode = True
+            cores.compute_fused_batch(
+                ["scl"], [a], CID, n, lr, iters,
+                value_args={"scl": (scale,)})
+            cr.barrier()
+            cr.enqueue_mode = False  # flush deferred readbacks
+            img = np.asarray(a)
+            print(json.dumps({
+                "op": "done",
+                "fused_compiles":
+                    cores.program.fused_compiled_count - before[0],
+                "call_compiles":
+                    cores.program.compiled_count - before[1],
+                "value": float(img[0]),
+                "uniform": bool(np.all(img == img[0])),
+            }), flush=True)
+        elif op == "stats":
+            stats = CACHE.stats() if CACHE.enabled else {}
+            print(json.dumps({"op": "stats", "stats": stats}),
+                  flush=True)
+        else:
+            print(json.dumps({"op": "error", "error": f"bad op {op!r}"}),
+                  flush=True)
+    cr.dispose()
+
+
+if __name__ == "__main__":
+    main()

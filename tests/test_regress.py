@@ -42,6 +42,7 @@ HEADLINE = {
     "fabric_chaos_goodput_frac": 0.8,
     "drain_recover_ms": 900.0,
     "rejoin_converge_iters": 4.0,
+    "cold_start_warm_speedup": 20.0,
 }
 
 

@@ -125,6 +125,12 @@ WATCHED_KEYS = (
     # starve the key, never ship a number).  Floor is wide: the whole
     # run rides thread scheduling on a contended CPU container
     ("fabric_chaos_goodput_frac", (), "higher", 0.30),
+    # persistent executable cache (ISSUE 18, bench section "cold_start"):
+    # process-cold / cache-warm first-batch latency ratio for the n-body
+    # ladder (higher is better; exactness-gated to None if the cache is
+    # not bit-invisible).  Floor is wide: the numerator is one
+    # subprocess's XLA compile wall on a contended CPU container
+    ("cold_start_warm_speedup", (), "higher", 0.50),
 )
 
 #: Trajectory-noise widening: tolerance = max(floor, NOISE_K * CV).
@@ -152,6 +158,7 @@ KEY_SECTION = {
     "drain_recover_ms": "resilience",
     "rejoin_converge_iters": "resilience",
     "fabric_chaos_goodput_frac": "serving_fabric",
+    "cold_start_warm_speedup": "cold_start",
 }
 
 
