@@ -1355,6 +1355,19 @@ def main() -> None:
                 serving.get("chaos_p99_ms")
                 if isinstance(serving, dict) else None
             ),
+            # the request-lifecycle tail anatomy (ISSUE 19): what
+            # fraction of the closed-loop p99 request's wall was spent
+            # waiting to dispatch (admitted + queued + coalesce-wait)
+            # vs inside the device window — the decomposition that
+            # tells a queueing regression from a compute regression
+            "serve_p99_queue_frac": (
+                serving.get("p99_queue_frac")
+                if isinstance(serving, dict) else None
+            ),
+            "serve_p99_device_frac": (
+                serving.get("p99_device_frac")
+                if isinstance(serving, dict) else None
+            ),
             # the cluster fabric's keys (ISSUE 17): sharded-frontend
             # goodput/p99 vs the single-frontend baseline at the same
             # load, and the kill-and-reroute drill's goodput-retained

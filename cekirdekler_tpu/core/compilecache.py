@@ -63,6 +63,7 @@ __all__ = [
     "CompileCache",
     "CACHE",
     "warm_from_disk",
+    "probe_counts",
 ]
 
 CACHE_ENV = "CK_COMPILE_CACHE"
@@ -88,6 +89,14 @@ _M_WRITE = REGISTRY.counter(
 _M_EVICT = REGISTRY.counter(
     "ck_compile_cache_evict_total",
     "files evicted by the persistent cache's LRU size cap")
+
+
+def probe_counts() -> tuple[int, int]:
+    """Current (hit, miss) probe totals — the fused-batch phase hook's
+    sampling point (``Cores.compute_fused_batch`` reads a before/after
+    delta so the serving tier can stamp a ``warm-compile``
+    request-lifecycle phase when a window paid a compile miss)."""
+    return (int(_M_HIT.value), int(_M_MISS.value))
 
 
 def _canon_values(value_args) -> list:

@@ -1223,6 +1223,16 @@ class Cores:
             kernel_names, params, compute_id, global_range, local_range,
             global_offset, value_args,
         )
+        # fused-batch phase hook (obs/reqtrace.py): sample the
+        # persistent compile cache's probe counters around the batch so
+        # the serving tier can stamp a `warm-compile` lifecycle phase
+        # when THIS window paid a miss.  One attribute read when the
+        # cache is unarmed.
+        probe_cache = COMPILE_CACHE.enabled
+        if probe_cache:
+            from .compilecache import probe_counts
+
+            hits0, misses0 = probe_counts()
         done = 0
         ladder = 0
         try:
@@ -1274,12 +1284,19 @@ class Cores:
                 clean=bool(getattr(e, "_ck_clean_window", False)),
                 original=e,
             ) from e
-        return {
+        out = {
             "iters": iters,
             "fused": ladder > 0,
             "ladder_iters": ladder,
             "per_call_iters": iters - ladder,
         }
+        if probe_cache:
+            from .compilecache import probe_counts
+
+            hits1, misses1 = probe_counts()
+            out["cache_hits"] = hits1 - hits0
+            out["cache_misses"] = misses1 - misses0
+        return out
 
     # -- AOT warmup / persistent executable cache (ROADMAP item 4) -----------
     def _warm_targets(self) -> list:

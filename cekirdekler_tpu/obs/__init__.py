@@ -16,6 +16,12 @@ Three pillars over the r7 tracer and r9 metrics registry (see
   stream-stall walls with an N×-threshold + hysteresis degradation
   detector; advisory verdicts only (``suggest_drain`` names lanes, the
   elastic tier — ROADMAP item 4 — is the consumer that will act).
+- :mod:`.reqtrace` — request-lifecycle tracing: every serving-tier
+  request's phase-transition events (admitted → queued → coalesce-wait
+  → dispatched → device → resolved, plus containment/retry/fabric
+  hops) in an always-on bounded ring keyed by a fabric-unique ``rid``;
+  the pure ``tail_anatomy`` fold decomposes p50/p95/p99 into per-phase
+  milliseconds (also served live on ``/reqz``).
 - :mod:`.decisions` — decision PROVENANCE: the event-sourced log of
   every controller decision with inputs sufficient to reproduce it;
   :mod:`.replay` + ``tools/ckreplay.py`` replay-verify it bit-
@@ -53,6 +59,16 @@ from .health import (
     evaluate_window,
     registry_health_summary,
 )
+from .reqtrace import (
+    REQ_EVENT_KINDS,
+    REQTRACE,
+    ReqEvent,
+    ReqTrace,
+    fold_phases,
+    request_chrome_events,
+    reqz_payload,
+    tail_anatomy,
+)
 
 __all__ = [
     "DEBUG_PORT_ENV",
@@ -68,14 +84,22 @@ __all__ = [
     "HealthMonitor",
     "POSTMORTEM_DIR_ENV",
     "REPLAYABLE_KINDS",
+    "REQTRACE",
+    "REQ_EVENT_KINDS",
+    "ReqEvent",
+    "ReqTrace",
     "VERDICTS",
     "cluster_health_table",
     "dump_postmortem",
     "evaluate_window",
+    "fold_phases",
     "load_decision_log",
     "load_postmortem",
     "postmortem_spans",
     "record_crash",
     "registry_health_summary",
+    "request_chrome_events",
+    "reqz_payload",
     "serve_debug",
+    "tail_anatomy",
 ]

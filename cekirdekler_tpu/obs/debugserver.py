@@ -36,7 +36,13 @@ Endpoints (all GET, all JSON unless noted):
   from a spilled log).
 - ``/servez`` — the serving tier (``serve/frontend.py``): every live
   frontend's queue depth, signature-group table (pending + starvation
-  streaks), per-tenant accounting, and admission configuration.
+  streaks), per-tenant accounting, admission configuration, and the
+  windowed (last-N) latency snapshot next to the cumulative tenant
+  stats.
+- ``/reqz`` — request-lifecycle tracing (``obs/reqtrace.py``): recent
+  requests, the slowest-N with per-phase breakdowns, per-tenant phase
+  percentiles, and the p50/p95/p99 tail anatomy with its coverage
+  fraction (``?slow=N`` / ``?n=N`` size the views).
 
 Lock discipline (the hot-path contract): every endpoint reads
 SNAPSHOTS — ``REGISTRY.snapshot()`` copies under the registry lock,
@@ -152,6 +158,7 @@ class DebugServer:
             "/profilez": self._profilez,
             "/decisionz": self._decisionz,
             "/servez": self._servez,
+            "/reqz": self._reqz,
         }.get(url.path)
         if route is None:
             self._reply(h, 404, _json_bytes(
@@ -172,7 +179,8 @@ class DebugServer:
     def _index(self, h, q) -> None:
         self._reply(h, 200, _json_bytes({
             "endpoints": ["/metrics", "/statusz", "/tracez", "/healthz",
-                          "/flightz", "/profilez", "/decisionz", "/servez"],
+                          "/flightz", "/profilez", "/decisionz", "/servez",
+                          "/reqz"],
             "uptime_s": round(time.time() - self._t0, 3),
         }))
 
@@ -327,6 +335,26 @@ class DebugServer:
         from ..serve.frontend import servez_payload
 
         self._reply(h, 200, _json_bytes(servez_payload()))
+
+    def _reqz(self, h, q) -> None:
+        # reqz_payload folds ONE recorder snapshot (the flight-ring
+        # copy discipline) — no serving state is touched, nothing
+        # blocks a submit
+        from .reqtrace import reqz_payload
+
+        n_slow, n_recent = 10, 50
+        if q.get("slow"):
+            try:
+                n_slow = max(1, min(1024, int(q["slow"][0])))
+            except ValueError:
+                pass
+        if q.get("n"):
+            try:
+                n_recent = max(1, min(4096, int(q["n"][0])))
+            except ValueError:
+                pass
+        self._reply(h, 200, _json_bytes(
+            reqz_payload(n_slow=n_slow, n_recent=n_recent)))
 
     # -- lifecycle -----------------------------------------------------------
     def close(self) -> None:

@@ -59,6 +59,7 @@ __all__ = [
     "simulate_balance",
     "explain_balance",
     "explain_latest",
+    "explain_rid",
     "convergence_summary",
     "bench_decisions_summary",
     "decisionz_payload",
@@ -924,6 +925,49 @@ def explain_latest(records, cid=None) -> dict | None:
     if not recs:
         return None
     return explain_balance(recs[-1])
+
+
+def _mentions_rid(inp: dict, rid: str) -> bool:
+    """Does a decision record's input snapshot name this request?  The
+    rid rides three shapes: a scalar ``rid`` (admission, retry, route),
+    a flat ``rids`` list (containment), and per-group ``rids`` inside a
+    coalesce record's ``groups`` rows."""
+    if inp.get("rid") == rid:
+        return True
+    if rid in (inp.get("rids") or ()):
+        return True
+    for g in inp.get("groups") or ():
+        if isinstance(g, dict) and rid in (g.get("rids") or ()):
+            return True
+    return False
+
+
+def explain_rid(records, rid: str) -> dict:
+    """One request's decision history (``ckreplay explain --rid <id>``):
+    every recorded controller decision whose INPUTS named this rid —
+    the admission verdict, the coalesce wave(s) that grouped it, any
+    containment/retry it rode, and the fabric route/re-route hops — in
+    seq order.  Pure filtering of the records' own inputs/outputs
+    (nothing re-derived; re-derivation is replay-verify's job).  The
+    rid is a decision INPUT, so this is the causal complement of the
+    reqtrace timeline: ``fold_phases`` says WHERE the milliseconds
+    went, this says WHICH verdicts routed them there.  Decisions
+    recorded while the log was disabled (or by a pre-rid build) carry
+    no rid and simply do not appear."""
+    rid = str(rid)
+    steps: list = []
+    kinds: dict = {}
+    for r in _rows(records):
+        inp = r.get("inputs") or {}
+        if not _mentions_rid(inp, rid):
+            continue
+        kinds[r["kind"]] = kinds.get(r["kind"], 0) + 1
+        steps.append({
+            "seq": r.get("seq"), "t": r.get("t"), "kind": r["kind"],
+            "inputs": inp, "outputs": r.get("outputs") or {},
+        })
+    return {"rid": rid, "decisions": len(steps), "kinds": kinds,
+            "steps": steps}
 
 
 # ---------------------------------------------------------------------------

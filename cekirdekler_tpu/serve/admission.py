@@ -297,11 +297,15 @@ class AdmissionController:
         breaker_open: bool = False,
         breaker_retry_after_s: float | None = None,
         brownout: bool = False,
+        rid: str | None = None,
     ) -> dict:
         """One admission decision for ``tenant``, recorded with its
         complete inputs (kind ``admission``).  Returns the
         :func:`admit_decision` dict; the caller raises
         :class:`ServeRejected` / increments its own accounting.
+        ``rid`` is the request's lifecycle id (obs/reqtrace.py) —
+        recorded as a decision INPUT (``ckreplay explain --rid``
+        filters on it; the pure oracle ignores it).
 
         ``kernel_unsafe``/``kernel_finding`` come from the caller's
         kernel-verifier gate (``ServeFrontend.submit`` under
@@ -348,5 +352,6 @@ class AdmissionController:
                 "brownout": bool(brownout),
                 "shed_quota": int(shed_quota),
                 "priority": int(priority),
+                "rid": None if rid is None else str(rid),
             }, dict(dec))
         return dec

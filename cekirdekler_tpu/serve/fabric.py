@@ -58,6 +58,7 @@ from ..errors import CekirdeklerError
 from ..metrics.registry import REGISTRY
 from ..obs.decisions import DECISIONS
 from ..obs.flight import FLIGHT
+from ..obs.reqtrace import REQTRACE
 from ..cluster.elastic import Membership, resume_window, save_window
 from .admission import ServeRejected
 from .frontend import ServeFrontend, ServeJob
@@ -293,10 +294,13 @@ class ShardRouter:
             return {m: list(r) for m, r in self._unhealthy.items()}
 
     # -- routing -------------------------------------------------------------
-    def route(self, tenant: str, key: str) -> dict:
+    def route(self, tenant: str, key: str, rid: str | None = None) -> dict:
         """Route one (tenant, key): snapshot the live epoch's roster
         and health view, run the pure function, record the replayable
-        ``route`` decision with exactly the inputs it consumed."""
+        ``route`` decision with exactly the inputs it consumed.
+        ``rid`` (the request-lifecycle id, obs/reqtrace.py) rides the
+        record as an input — the ``ckreplay explain --rid`` join key;
+        the pure oracle ignores it."""
         snap = self.membership.snapshot()
         roster = sorted(snap["members"], key=_order)
         with self._mu:
@@ -323,6 +327,7 @@ class ShardRouter:
                 "members": roster,
                 "unhealthy": list(unhealthy),
                 "epoch": snap["epoch"],
+                "rid": None if rid is None else str(rid),
             }, dict(out))
         return out
 
@@ -458,11 +463,19 @@ class ServeFabric:
         if self._halt:
             raise CekirdeklerError(f"fabric {self.name!r} is closed")
         jb = job if isinstance(job, ServeJob) else ServeJob(**job)
+        # the fabric mints the lifecycle id (obs/reqtrace.py): the SAME
+        # rid rides every hop — route, shard submit, preemption
+        # re-route — so a killed member's request folds into ONE chain
+        rid = REQTRACE.mint()
         key = fabric_key(jb)
         self._maybe_refresh()
-        out = self.router.route(tenant, key)
+        out = self.router.route(tenant, key, rid=rid)
         if out["shard"] is None:
             raise ServeRejected(str(tenant), REJECT_SHARD, _SHARD_RETRY_S)
+        if out["diverted"] and REQTRACE.enabled:
+            REQTRACE.event(rid, "diverted", tenant=str(tenant),
+                           owner=out["owner"], shard=out["shard"],
+                           hops=out["hops"])
         with self._mu:
             self._observed[key] = jb
             fe = self.shards.get(out["shard"])
@@ -472,7 +485,7 @@ class ServeFabric:
             raise ServeRejected(str(tenant), REJECT_SHARD, _SHARD_RETRY_S)
         outer: Future = Future()
         try:
-            inner = fe.submit(tenant, jb, deadline=deadline)
+            inner = fe.submit(tenant, jb, deadline=deadline, rid=rid)
         except ServeRejected:
             raise
         except CekirdeklerError as e:
@@ -481,10 +494,10 @@ class ServeFabric:
             # the shard died between route and submit: same re-route
             # path an in-flight preemption takes
             self._reroute(outer, str(tenant), jb, deadline,
-                          out["shard"], e, attempt=0)
+                          out["shard"], e, attempt=0, rid=rid)
             return outer
         self._watch(outer, inner, str(tenant), jb, deadline,
-                    out["shard"], attempt=0)
+                    out["shard"], attempt=0, rid=rid)
         return outer
 
     def call(self, tenant: str, job, deadline: float | None = None,
@@ -493,7 +506,8 @@ class ServeFabric:
         return self.submit(tenant, job, deadline=deadline).result(timeout)
 
     def _watch(self, outer: Future, inner: Future, tenant: str,
-               jb: ServeJob, deadline, shard_id: str, attempt: int) -> None:
+               jb: ServeJob, deadline, shard_id: str, attempt: int,
+               rid: str | None = None) -> None:
         def _done(f: Future) -> None:
             if f.cancelled():
                 outer.cancel()
@@ -503,14 +517,14 @@ class ServeFabric:
                 _settle(outer, value=f.result())
             elif _reroutable(exc) and not self._halt:
                 self._reroute(outer, tenant, jb, deadline, shard_id,
-                              exc, attempt)
+                              exc, attempt, rid=rid)
             else:
                 _settle(outer, exc=exc)
         inner.add_done_callback(_done)
 
     def _reroute(self, outer: Future, tenant: str, jb: ServeJob,
                  deadline, from_shard: str, cause: BaseException,
-                 attempt: int) -> None:
+                 attempt: int, rid: str | None = None) -> None:
         """One budget-gated preemption re-route: consult the SAME pure
         ``retry_decision`` the in-shard retry path uses (recorded, so
         replay verifies the re-route was granted from its logged
@@ -529,6 +543,7 @@ class ServeFabric:
                 "base_s": 0.0, "cap_s": 0.0, "jitter_u": u,
                 "tenant": tenant,
                 "cause": f"shard-preempted:{from_shard}",
+                "rid": None if rid is None else str(rid),
             }, dict(rd))
         if not rd["retry"]:
             _settle(outer, exc=cause)
@@ -536,7 +551,7 @@ class ServeFabric:
         self.retry_budgets.spend(tenant)
         self.router.mark(from_shard, ("shard-unavailable",))
         key = fabric_key(jb)
-        out = self.router.route(tenant, key)
+        out = self.router.route(tenant, key, rid=rid)
         with self._mu:
             fe = (self.shards.get(out["shard"])
                   if out["shard"] is not None else None)
@@ -550,18 +565,30 @@ class ServeFabric:
                 "fabric-reroute", tenant=tenant, key=key,
                 from_shard=from_shard, to_shard=out["shard"],
                 attempt=attempt, cause=str(cause)[:200])
+        if rid is not None and REQTRACE.enabled:
+            # the hop chain: the route off the dead owner stamps
+            # `diverted`, the survivor re-submit stamps `rerouted` —
+            # the SAME rid continues on the new shard (and, over the
+            # `_fabric_worker` wire, in the new process)
+            if out["diverted"]:
+                REQTRACE.event(rid, "diverted", tenant=tenant,
+                               owner=out["owner"], shard=out["shard"],
+                               hops=out["hops"])
+            REQTRACE.event(rid, "rerouted", tenant=tenant,
+                           from_shard=from_shard, to_shard=out["shard"],
+                           attempt=attempt)
         try:
-            inner = fe.submit(tenant, jb, deadline=deadline)
+            inner = fe.submit(tenant, jb, deadline=deadline, rid=rid)
         except Exception as e:  # noqa: BLE001 - judged below
             if _reroutable(e) and attempt + 1 < self.reroute_max_attempts \
                     and not self._halt:
                 self._reroute(outer, tenant, jb, deadline, out["shard"],
-                              e, attempt + 1)
+                              e, attempt + 1, rid=rid)
             else:
                 _settle(outer, exc=e)
             return
         self._watch(outer, inner, tenant, jb, deadline, out["shard"],
-                    attempt + 1)
+                    attempt + 1, rid=rid)
 
     # -- membership ----------------------------------------------------------
     def remove_member(self, member: str, total: int | None = None,

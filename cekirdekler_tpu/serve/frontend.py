@@ -55,6 +55,7 @@ from ..errors import (
 from ..metrics.registry import REGISTRY
 from ..obs.decisions import DECISIONS
 from ..obs.flight import FLIGHT, record_crash
+from ..obs.reqtrace import REQTRACE
 from ..utils.faultinject import FAULTS
 from .admission import AdmissionController, ServeRejected
 from .coalescer import plan_coalesce
@@ -73,6 +74,29 @@ __all__ = ["ServeFrontend", "ServeJob", "servez_payload"]
 #: Requests-per-batch histogram buckets (count-flavored, not the
 #: seconds-flavored defaults).
 _BATCH_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512)
+
+#: Windowed-latency ring size: the ``/servez`` row reports p50/p99
+#: over the last N settled requests NEXT TO the lifetime-cumulative
+#: tenant accounting — a live operator needs the CURRENT tail, and a
+#: long-lived frontend's cumulative stats dilute a regime change into
+#: invisibility (pinned by the two-regime test).
+_LAT_WINDOW = 512
+
+
+def _window_latency(values, window: int = _LAT_WINDOW) -> dict:
+    """PURE: the windowed p50/p99 snapshot for the ``/servez`` row
+    (nearest-rank over the last ``window`` settled-request walls)."""
+    vals = sorted(float(v) for v in list(values)[-window:])
+    if not vals:
+        return {"window": window, "count": 0,
+                "p50_ms": None, "p99_ms": None}
+    n = len(vals)
+
+    def _rank(p):
+        return vals[min(max(int(round(p / 100.0 * (n - 1))), 0), n - 1)]
+
+    return {"window": window, "count": n,
+            "p50_ms": _rank(50.0) * 1e3, "p99_ms": _rank(99.0) * 1e3}
 
 
 @dataclass(frozen=True)
@@ -111,6 +135,8 @@ class _Request:
     future: Future
     t_submit: float
     deadline_t: float | None  # absolute perf_counter, None = no deadline
+    rid: str = ""             # lifecycle id (obs/reqtrace.py)
+    rt_queued: bool = False   # "queued" phase event already stamped
 
 
 @dataclass
@@ -211,6 +237,10 @@ class ServeFrontend:
         self._halt = False
         self._dead: str | None = None  # dispatcher-crash cause (named)
         self._thread: threading.Thread | None = None
+        # windowed settle latencies (seconds) — GIL-atomic appends from
+        # the settle sites, snapshot-read by stats() (reporting only)
+        # ckcheck: ok lock-free deque ring, list() copy on read, reporting-only tolerance
+        self._lat_recent: deque = deque(maxlen=_LAT_WINDOW)
         # -- resilience layer (serve/resilience.py) --------------------------
         rc = self.resilience = rc0
         self.breakers = BreakerBoard(
@@ -258,7 +288,8 @@ class ServeFrontend:
 
     # -- client API ----------------------------------------------------------
     def submit(self, tenant: str, job: ServeJob,
-               deadline: float | None = None) -> Future:
+               deadline: float | None = None,
+               rid: str | None = None) -> Future:
         """Submit one job for ``tenant``; returns a
         :class:`~concurrent.futures.Future` resolving to the request
         record (``{"tenant", "latency_s", "batch_requests", "fused",
@@ -266,7 +297,11 @@ class ServeFrontend:
         host arrays are current at that point.  ``deadline`` is
         seconds-from-now (deadline-aware ordering; a late completion is
         flagged, never dropped).  Raises :class:`ServeRejected` (with
-        ``retry_after_s``) when admission refuses."""
+        ``retry_after_s``) when admission refuses.  ``rid`` is the
+        request's lifecycle id (obs/reqtrace.py) — minted here when
+        absent, passed through by :class:`~.fabric.ServeFabric` so a
+        re-routed request keeps ONE rid across shards and
+        processes."""
         if self._halt:
             raise CekirdeklerError(f"frontend {self.name!r} is closed")
         if self._dead is not None:
@@ -276,6 +311,7 @@ class ServeFrontend:
             raise CekirdeklerError(
                 f"frontend {self.name!r} dispatcher died: {self._dead}")
         t0 = time.perf_counter()
+        rid = rid or REQTRACE.mint()
         jb = job if isinstance(job, ServeJob) else ServeJob(**job)
         sig = jb.signature()
         try:
@@ -333,7 +369,7 @@ class ServeFrontend:
                                 if kernel_finding else None),
                 breaker_open=not brk["allow"],
                 breaker_retry_after_s=brk["retry_after_s"],
-                brownout=self._brownout_active)
+                brownout=self._brownout_active, rid=rid)
             if brk["probe"] and not dec["admit"]:
                 self.breakers.release_probe(bkey)
             if dec["admit"]:
@@ -349,20 +385,39 @@ class ServeFrontend:
                     job=jb, tenant=str(tenant), future=fut, t_submit=t0,
                     deadline_t=(t0 + float(deadline)
                                 if deadline is not None else None),
+                    rid=rid,
                 ))
                 self._pending += 1
                 self._m_queue_depth.set(self._pending)
+                if REQTRACE.enabled:
+                    # stamped INSIDE the lock: the dispatcher could pop
+                    # this request the moment the lock releases, and a
+                    # "queued" stamp landing before "admitted" would
+                    # fold into a negative phase.  wait_s is the
+                    # pre-event admission wait the chain's telescoping
+                    # cannot see (no earlier stamp exists).
+                    REQTRACE.event(
+                        rid, "admitted", tenant=str(tenant),
+                        group=g.key,
+                        wait_s=time.perf_counter() - t0)
                 self._mu.notify()
         if not dec["admit"]:
             self.tenants.note_rejected(st, dec["reason"])
+            if REQTRACE.enabled:
+                REQTRACE.event(
+                    rid, "failed", name=str(dec["reason"]),
+                    tenant=str(tenant),
+                    latency_s=time.perf_counter() - t0)
             raise ServeRejected(
                 str(tenant), dec["reason"], float(dec["retry_after_s"]))
         return fut
 
     def call(self, tenant: str, job: ServeJob,
-             deadline: float | None = None, timeout: float | None = None):
+             deadline: float | None = None, timeout: float | None = None,
+             rid: str | None = None):
         """Blocking convenience: ``submit(...).result(timeout)``."""
-        return self.submit(tenant, job, deadline=deadline).result(timeout)
+        return self.submit(tenant, job, deadline=deadline,
+                           rid=rid).result(timeout)
 
     # -- the dispatcher ------------------------------------------------------
     def start(self) -> None:
@@ -472,9 +527,15 @@ class ServeFrontend:
             self._m_queue_depth.set(0)
         for r in leftovers:
             st = self.tenants.state(r.tenant)
+            lat = time.perf_counter() - r.t_submit
             self.tenants.note_done(
-                st, time.perf_counter() - r.t_submit, failed=True,
-                deadline_missed=False)
+                st, lat, failed=True, deadline_missed=False)
+            if REQTRACE.enabled:
+                # NOT chain-terminal when the fabric re-routes: the
+                # outer future catches this named clean failure and the
+                # same rid continues with `rerouted` on a survivor
+                REQTRACE.event(r.rid, "failed", name="shutdown",
+                               tenant=r.tenant, latency_s=lat)
             self._settle(r.future, exc=CekirdeklerError(message))
 
     def step(self) -> dict:
@@ -498,8 +559,18 @@ class ServeFrontend:
             for g in self._groups.values():
                 if not g.reqs:
                     continue
-                deadlines = [r.deadline_t for r in g.reqs
-                             if r.deadline_t is not None]
+                deadlines = []
+                for r in g.reqs:
+                    if r.deadline_t is not None:
+                        deadlines.append(r.deadline_t)
+                    if not r.rt_queued:
+                        # "queued" stamps ONCE per request, at the
+                        # first planning cycle that sees its group —
+                        # the queued phase is submit → cycle entry,
+                        # the coalesce-wait phase starts here
+                        r.rt_queued = True
+                        if REQTRACE.enabled:
+                            REQTRACE.event(r.rid, "queued", group=g.key)
                 summary.append({
                     "key": g.key,
                     "pending": len(g.reqs),
@@ -507,6 +578,12 @@ class ServeFrontend:
                                       if deadlines else None),
                     "oldest_age_s": now - g.reqs[0].t_submit,
                     "starved_rounds": g.starved,
+                    # rids ride the coalesce record as an INPUT (the
+                    # `ckreplay explain --rid` join key; the pure
+                    # plan_coalesce ignores unknown keys) — built only
+                    # when the log is on
+                    "rids": ([r.rid for r in g.reqs]
+                             if DECISIONS.enabled else []),
                 })
             rnd = self._round
             self._round += 1
@@ -529,6 +606,13 @@ class ServeFrontend:
                     self._pending -= len(take)
                     g.starved = 0
                     batches.append((g, take))
+                    if REQTRACE.enabled:
+                        # the coalescer picked this group: the
+                        # batching delay (cycle entry → pick) closes
+                        for r in take:
+                            REQTRACE.event(
+                                r.rid, "coalesce-wait", group=g.key,
+                                round=rnd, batch=len(take))
                 elif g.reqs:
                     g.starved += 1
                 if not g.reqs:
@@ -561,6 +645,10 @@ class ServeFrontend:
                         failed=True, deadline_missed=False)
                 except Exception:  # noqa: BLE001 - settling outranks it
                     pass
+                if REQTRACE.enabled:
+                    REQTRACE.event(
+                        r.rid, "failed", name="dispatch-cycle-crash",
+                        tenant=r.tenant, latency_s=t_c - r.t_submit)
                 self._settle(r.future, exc=err)
             raise
 
@@ -601,6 +689,17 @@ class ServeFrontend:
                 err = err or sync_err
                 st = self.tenants.state(r.tenant)
                 lat = t_done - r.t_submit
+                self._lat_recent.append(lat)
+                if REQTRACE.enabled:
+                    # the fused-window wall retired at t_done (barrier
+                    # fence + flush): this stamp closes every batch
+                    # rider's device phase; the window wall and batch
+                    # size ride along for apportionment
+                    REQTRACE.event(
+                        r.rid, "device",
+                        window_wall_s=t_done - now,
+                        batch_requests=len(reqs),
+                        fused=bool(info and info.get("fused")))
                 bkey = (r.tenant, g.sig, r.job.compute_id)
                 if err is not None:
                     n_failed += 1
@@ -619,6 +718,11 @@ class ServeFrontend:
                             # breaker feeds the brownout pressure
                             self.breakers.note(
                                 ("lane", int(lane)), "failure", t_done)
+                    if REQTRACE.enabled:
+                        REQTRACE.event(
+                            r.rid, "failed",
+                            name=type(err).__name__, tenant=r.tenant,
+                            latency_s=lat)
                     self._settle(r.future, exc=err)
                     continue
                 missed = (r.deadline_t is not None
@@ -627,6 +731,10 @@ class ServeFrontend:
                     st, lat, failed=False, deadline_missed=missed)
                 self.breakers.note(bkey, "success", t_done)
                 self.retry_budgets.note_success(r.tenant)
+                if REQTRACE.enabled:
+                    REQTRACE.event(
+                        r.rid, "resolved", tenant=r.tenant,
+                        latency_s=lat, deadline_missed=missed)
                 self._settle(r.future, value={
                     "tenant": r.tenant,
                     "latency_s": lat,
@@ -661,10 +769,13 @@ class ServeFrontend:
                 return
         err = self._shutdown_error()
         for _g, r in requeue:
+            lat = time.perf_counter() - r.t_submit
             self.tenants.note_done(
-                self.tenants.state(r.tenant),
-                time.perf_counter() - r.t_submit, failed=True,
+                self.tenants.state(r.tenant), lat, failed=True,
                 deadline_missed=False)
+            if REQTRACE.enabled:
+                REQTRACE.event(r.rid, "failed", name="shutdown",
+                               tenant=r.tenant, latency_s=lat)
             self._settle(r.future, exc=err)
 
     # -- blast-radius containment (serve/resilience.py) ----------------------
@@ -687,6 +798,9 @@ class ServeFrontend:
         budget is the cross-cycle bound)."""
         jb = reqs[0].job
         n = len(reqs)
+        if REQTRACE.enabled:
+            for r in reqs:
+                REQTRACE.event(r.rid, "dispatched", group=g.key, batch=n)
         infos: list = [None] * n
         errs: list = [None] * n
         attempts = [0] * n
@@ -718,6 +832,16 @@ class ServeFrontend:
                     global_offset=jb.global_offset,
                     value_args=jb.values,
                 )
+                if REQTRACE.enabled and info.get("cache_misses"):
+                    # the window paid a compile-cache miss (the cores
+                    # fused-batch hook samples core/compilecache's
+                    # counters around the dispatch): the warm/compile
+                    # phase splits off the device wall for this batch
+                    for i in range(start, start + count):
+                        REQTRACE.event(
+                            reqs[i].rid, "warm-compile",
+                            misses=info["cache_misses"],
+                            hits=info.get("cache_hits", 0))
                 for i in range(start, start + count):
                     infos[i] = info
             except Exception as e:  # noqa: BLE001 - contained below
@@ -774,6 +898,10 @@ class ServeFrontend:
             FLIGHT.event("serve-contain", frontend=self.name,
                          group=g.key, cause=cause, outcome="aborted",
                          requests=len(reqs))
+            if REQTRACE.enabled:
+                for r in reqs:
+                    REQTRACE.event(r.rid, "contained", group=g.key,
+                                   cause=cause, outcome="aborted")
             return
         applied = max(0, min(int(applied), count))
         for i in range(start, start + applied):
@@ -794,6 +922,11 @@ class ServeFrontend:
             FLIGHT.event("serve-contain", frontend=self.name,
                          group=g.key, cause=cause, outcome="aborted",
                          requests=rest)
+            if REQTRACE.enabled:
+                for i in range(rest_start, rest_start + rest):
+                    REQTRACE.event(
+                        reqs[i].rid, "contained", group=g.key,
+                        cause=cause, outcome="aborted")
             return
         if rest > 1:
             plan = containment_plan(rest, rc.bisect_leaf)
@@ -801,10 +934,19 @@ class ServeFrontend:
                 DECISIONS.record("containment", {
                     "k": rest, "leaf": rc.bisect_leaf,
                     "group": g.key, "cause": cause,
+                    "rids": [reqs[i].rid
+                             for i in range(rest_start,
+                                            rest_start + rest)],
                 }, dict(plan))
             FLIGHT.event("serve-contain", frontend=self.name,
                          group=g.key, cause=cause, outcome="bisect",
                          parts=list(plan["parts"]))
+            if REQTRACE.enabled:
+                for i in range(rest_start, rest_start + rest):
+                    REQTRACE.event(
+                        reqs[i].rid, "contained", group=g.key,
+                        cause=cause, outcome="bisect",
+                        parts=len(plan["parts"]))
             off = rest_start
             parts = []
             for p in plan["parts"]:
@@ -833,6 +975,7 @@ class ServeFrontend:
                 "jitter_u": u,
                 "tenant": r.tenant,
                 "cause": cause,
+                "rid": r.rid,
             }, dict(rd))
         if rd["retry"] and self._halt:
             # a GRANTED retry suppressed by shutdown is a shutdown
@@ -853,6 +996,10 @@ class ServeFrontend:
                 sleep_left[0] -= delay
                 attempts[i] += 1
                 time.sleep(delay)
+                if REQTRACE.enabled:
+                    REQTRACE.event(
+                        r.rid, "retry-backoff", delay_s=delay,
+                        attempt=attempts[i], deferred=False)
                 work.appendleft((i, 1))
             else:
                 # the cycle's inline-sleep budget is spent: a blocking
@@ -861,12 +1008,19 @@ class ServeFrontend:
                 # cycle instead; the gather cadence is the spacing
                 errs[i] = _REQUEUED
                 requeue.append((g, r))
+                if REQTRACE.enabled:
+                    REQTRACE.event(
+                        r.rid, "retry-backoff", delay_s=delay,
+                        attempt=attempts[i], deferred=True)
             return
         errs[i] = base_err  # the NAMED cause, isolated to this request
         self._m_contained["isolated"].inc()
         FLIGHT.event("serve-contain", frontend=self.name, group=g.key,
                      cause=cause, outcome="isolated",
                      refusal=rd["reason"])
+        if REQTRACE.enabled:
+            REQTRACE.event(r.rid, "contained", group=g.key, cause=cause,
+                           outcome="isolated", refusal=rd["reason"])
 
     def _evaluate_brownout(self) -> dict:
         """One per-cycle brownout evaluation (cold): sample the
@@ -955,6 +1109,11 @@ class ServeFrontend:
                 "dispatcher_alive": (self._thread is not None
                                      and self._thread.is_alive()),
                 "groups": sorted(groups, key=lambda g: g["key"]),
+                # the CURRENT tail (last-N window) next to the
+                # lifetime-cumulative tenant accounting — a regime
+                # change shows here while the cumulative stats still
+                # dilute it (two-regime test)
+                "latency": _window_latency(self._lat_recent),
             }
         doc["tenants"] = self.tenants.snapshot()
         doc["admission"] = {

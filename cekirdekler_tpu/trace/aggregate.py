@@ -100,6 +100,13 @@ class ClusterSnapshot(dict):
       keyed by member; feed
       :func:`cekirdekler_tpu.serve.fabric.merge_shard_serving` for the
       job-wide serving totals
+    - ``reqtrace``: per-process request-lifecycle event rows
+      (``[]`` for a process that shipped none) — ``obs.reqtrace``
+      ``(t, rid, kind, fields)`` rows on WALL-clock stamps (epoch
+      seconds, cross-process comparable on one host without the offset
+      table); concatenate across processes and feed
+      :func:`cekirdekler_tpu.obs.reqtrace.fold_phases` so a rid whose
+      chain hopped shards reads as ONE record
     - ``nproc``
 
     (a dict subclass so it JSON-serializes untouched; spans are listed
@@ -130,6 +137,7 @@ def gather_cluster(
     skew_s: float = 0.0,
     health: dict | None = None,
     serving: dict | None = None,
+    reqtrace: Sequence | None = None,
 ) -> ClusterSnapshot:
     """Ship this process's spans + metrics + lane-health report to the
     cluster; return the merged, clock-aligned view (SPMD — every
@@ -169,10 +177,17 @@ def gather_cluster(
     # json_safe: a numpy scalar in a caller-supplied metrics snapshot or
     # an inf ratio in a health report must not kill (or corrupt) the
     # whole cluster gather — every peer decodes this payload strictly
+    # request-lifecycle rows ride as plain 4-lists; their stamps are
+    # WALL clock (time.time) by the reqtrace contract, so — unlike the
+    # spans — they need no per-process offset correction on one host
+    req_rows = [
+        [float(e[0]), str(e[1]), str(e[2]), dict(e[3] or {})]
+        for e in (reqtrace or ())
+    ]
     payload = json.dumps(
         json_safe(
             {"spans": rows, "metrics": metrics_snapshot, "health": health,
-             "serving": serving or {}}
+             "serving": serving or {}, "reqtrace": req_rows}
         ),
         allow_nan=False,
     ).encode()
@@ -187,6 +202,7 @@ def gather_cluster(
     per_proc_metrics: list[dict] = []
     per_proc_health: list[dict] = []
     per_proc_serving: list[dict] = []
+    per_proc_reqtrace: list[list] = []
     for p in range(len(sizes)):
         decoded = json.loads(
             gathered[p, : int(sizes[p])].tobytes().decode()
@@ -198,12 +214,15 @@ def gather_cluster(
         per_proc_health.append(decoded.get("health") or {})
         # same rule for serving stats (pre-fabric peers ship no key)
         per_proc_serving.append(decoded.get("serving") or {})
+        # and for request-lifecycle rows (pre-reqtrace peers ship none)
+        per_proc_reqtrace.append(decoded.get("reqtrace") or [])
     return ClusterSnapshot(
         offsets=offsets,
         spans=per_proc_spans,
         metrics=per_proc_metrics,
         health=per_proc_health,
         serving=per_proc_serving,
+        reqtrace=per_proc_reqtrace,
         nproc=len(sizes),
     )
 
@@ -212,7 +231,13 @@ def merged_chrome_trace(snapshot: ClusterSnapshot) -> dict:
     """One Chrome-trace/Perfetto dict for the whole job: one process
     block per DCN process, every block against process 0's clock, so
     cross-process causality (a collective's simultaneous appearance on
-    every track) is visible in the viewer."""
+    every track) is visible in the viewer.
+
+    When the snapshot carries ``reqtrace`` rows, every process's rows
+    are CONCATENATED and rendered as one ``requests`` process (one
+    thread per rid) — a rid whose lifecycle hopped shards after a
+    member kill therefore appears as a single continuous track, its
+    diverted → rerouted chain visible across the kill."""
     from .export import to_chrome_trace
 
     all_spans = [s for spans in snapshot["spans"] for s in spans]
@@ -224,6 +249,13 @@ def merged_chrome_trace(snapshot: ClusterSnapshot) -> dict:
             t_base=t_base,
         )
         events.extend(block["traceEvents"])
+    req_rows = [r for rows in snapshot.get("reqtrace") or [] for r in rows]
+    if req_rows:
+        from ..obs.reqtrace import request_chrome_events
+
+        # one call over the concatenation — the shared epoch base and
+        # the per-rid thread map are what fuse cross-shard chains
+        events.extend(request_chrome_events(req_rows))
     return {"traceEvents": events, "displayTimeUnit": "ms"}
 
 

@@ -783,6 +783,7 @@ def unified_chrome_trace(
     marks: Sequence[Mark] = (),
     counters: dict | None = None,
     process_name: str = "cekirdekler_tpu",
+    req_events: Sequence = (),
 ) -> dict:
     """Host spans + device ops on ONE timeline.
 
@@ -795,8 +796,13 @@ def unified_chrome_trace(
     exported against their own origin and the trace says so
     (``args.anchor: null`` on the metadata).  Marks replay as
     zero-cost ``device-mark`` instants so the dispatch edge is visible
-    beside the ops it explains.  ``split_unified_trace`` reads the
-    merged schema back — the round trip is pinned by test."""
+    beside the ops it explains.  ``req_events`` (obs/reqtrace.py
+    events) add per-request lifecycle tracks as their own ``requests``
+    process — one thread per rid, one slice per phase, cat ``ck-req``
+    (wall-clock stamps, exported against their own origin — the phase
+    anatomy is relative within each chain).  ``split_unified_trace``
+    reads the merged schema back, ignoring the request tracks — the
+    round trip is pinned by test."""
     from .export import to_chrome_trace
 
     spans = list(spans)
@@ -872,6 +878,9 @@ def unified_chrome_trace(
             "args": {"kernel": m.kernel, "ck-seq": m.seq, "cid": m.cid,
                      "kind": "device-mark"},
         })
+    if req_events:
+        from ..obs.reqtrace import request_chrome_events
+        events.extend(request_chrome_events(req_events))
     return doc
 
 
@@ -891,6 +900,7 @@ def split_unified_trace(trace: dict) -> tuple[list[Span], list[DeviceOp]]:
     host_events = [
         e for e in trace.get("traceEvents", ())
         if e.get("pid") not in dev_pids and e.get("ph") == "X"
+        and e.get("cat") != "ck-req"   # request-lifecycle tracks are not spans
     ]
     spans = from_chrome_trace({"traceEvents": host_events})
     ops: list[DeviceOp] = []

@@ -28,12 +28,20 @@ Protocol (one JSON object per line; every command gets one reply):
   k sequential blocking requests (the kill-test unit of work; the
   reply IS the ack — a SIGKILLed worker never acks, so the parent
   re-routes exactly the unacked rids) → ``{"op": "done", "rid", "sig",
-  "count"}``
+  "count"}``.  Optional ``"trace_rid": "r..."`` propagates the
+  parent's request-lifecycle id onto every submit, so one rid's chain
+  (``obs/reqtrace.py``) survives a shard hop: the survivor's
+  admitted → ... → resolved events carry the SAME rid the parent
+  stamped ``diverted``/``rerouted`` under.
 - ``{"op": "value", "sig": si}`` — the signature array's value (bit-
   exactness evidence: every element must equal the applied count) →
   ``{"op": "value", "sig", "value", "uniform": bool}``
 - ``{"op": "stats"}`` — the frontend ``stats()`` doc (the shard-health
   input) → ``{"op": "stats", "stats": {...}}``
+- ``{"op": "reqtrace"}`` — this shard's request-lifecycle ring as
+  plain rows → ``{"op": "reqtrace", "events": [[t, rid, kind,
+  fields], ...]}`` (wall-clock stamps — the parent concatenates the
+  shards' rows straight into one merged timeline)
 - ``{"op": "exit"}`` → ``{"op": "bye"}`` and a clean close.
 
 The workload kernel is loadgen's ``lg_inc`` (+1.0f per request):
@@ -176,12 +184,19 @@ def main(member: str, n: int, local_range: int,
         si = int(cmd["sig"])
         job = job_for(si)
         tenant = str(cmd.get("tenant", "t0"))
+        trace_rid = cmd.get("trace_rid")
         done = 0
         for _ in range(int(cmd.get("iters", 1))):
-            fe.call(tenant, job, timeout=60.0)
+            fe.call(tenant, job, timeout=60.0, rid=trace_rid)
             done += 1
         return {"op": "done", "rid": cmd.get("rid"), "sig": si,
                 "count": done}
+
+    def op_reqtrace(cmd: dict) -> dict:
+        from cekirdekler_tpu.obs.reqtrace import REQTRACE
+
+        return {"op": "reqtrace", "events": [
+            [e.t, e.rid, e.kind, e.fields] for e in REQTRACE.snapshot()]}
 
     def op_value(cmd: dict) -> dict:
         si = int(cmd["sig"])
@@ -219,6 +234,8 @@ def main(member: str, n: int, local_range: int,
                 reply = op_run(cmd)
             elif op == "value":
                 reply = op_value(cmd)
+            elif op == "reqtrace":
+                reply = op_reqtrace(cmd)
             elif op == "stats":
                 reply = {"op": "stats", "stats": {
                     k: v for k, v in fe.stats().items()

@@ -553,3 +553,184 @@ def test_three_process_seeded_kill_and_reroute(devs):
             if proc.poll() is None:
                 proc.kill()
             proc.wait(timeout=30.0)
+
+
+def _subsequence(seq, sub):
+    """Is ``sub`` an ordered (not necessarily contiguous) subsequence
+    of ``seq``?"""
+    it = iter(seq)
+    return all(any(x == want for x in it) for want in sub)
+
+
+def test_member_kill_rid_chain_survives_in_merged_cluster_trace(devs):
+    """The chaos-observability contract (reqtrace across the fabric
+    wire): 3 worker processes, a seeded mid-run SIGKILL, and the
+    parent re-routing the unacked requests onto ring survivors under
+    their ORIGINAL rids.  The merged cluster Perfetto trace must show
+    each killed-shard request as ONE rid on ONE request track whose
+    chain reads diverted → rerouted → … → resolved (subsequence), the
+    fold must report those rids resolved with near-full phase
+    coverage, and the survivors' arrays must stay bit-exact."""
+    from cekirdekler_tpu.obs.reqtrace import REQTRACE, fold_phases
+    from cekirdekler_tpu.trace.aggregate import (
+        ClusterSnapshot,
+        merged_chrome_trace,
+    )
+
+    members = ["m0", "m1", "m2"]
+    n, sigs, rids_per_sig = 2048, 3, 4
+    seed = 4099
+    procs = {m: _spawn_worker(m, n=n) for m in members}
+    membership = Membership()
+    membership.establish({m: 1 for m in members})
+    t_wall0 = time.time()
+    try:
+        ready = [threading.Thread(target=_await_ready,
+                                  args=(procs[m], m)) for m in members]
+        for t in ready:
+            t.start()
+        for t in ready:
+            t.join(timeout=200.0)
+        for m in members:
+            assert procs[m].poll() is None, f"worker {m} did not start"
+
+        # one placement per request, each carrying a parent-minted
+        # trace rid that must survive the hop
+        work = []  # (idx, trace_rid, tenant, si, shard)
+        idx = 0
+        for si in range(sigs):
+            key = f"cid{9100 + si}|lg_inc|{n}x64+0"
+            for j in range(rids_per_sig):
+                tenant = f"t{j % 2}"
+                shard = route_decision(
+                    tenant, key, members,
+                    epoch=membership.snapshot()["epoch"])["shard"]
+                work.append((idx, f"rkill-{idx:x}", tenant, si, shard))
+                idx += 1
+        by_shard = {m: [w for w in work if w[4] == m] for m in members}
+        victims = [m for m in members if len(by_shard[m]) >= 2]
+        victim = random.Random(seed).choice(sorted(victims))
+        survivors = [m for m in members if m != victim]
+
+        for m in members:
+            assert _rpc(procs[m], {
+                "op": "warm",
+                "sigs": sorted({w[3] for w in by_shard[m]}) or [0],
+            })["op"] == "warmed"
+
+        acked: dict = {}
+        unacked: list = []
+        failures: list = []
+        kill_at = 1  # SIGKILL after the victim's first ack (seeded)
+
+        def feed(m):
+            for w in by_shard[m]:
+                i, trid, tenant, si, _ = w
+                reply = _rpc(procs[m], {
+                    "op": "run", "rid": i, "trace_rid": trid,
+                    "tenant": tenant, "sig": si, "iters": 1})
+                if reply is None:
+                    if m == victim:
+                        unacked.append(w)
+                    else:
+                        failures.append((m, i, "eof"))
+                    continue
+                if reply.get("op") != "done":
+                    failures.append((m, i, reply))
+                    continue
+                acked[i] = m
+                if m == victim and len([v for v in acked.values()
+                                        if v == victim]) == kill_at:
+                    procs[m].kill()
+
+        feeders = [threading.Thread(target=feed, args=(m,))
+                   for m in members]
+        for t in feeders:
+            t.start()
+        for t in feeders:
+            t.join(timeout=240.0)
+        assert not any(t.is_alive() for t in feeders), "hung worker rpc"
+        assert failures == [], failures
+        assert unacked, "the seeded kill landed after the victim drained"
+
+        # re-route under the SAME rid, the parent (the fabric
+        # coordinator's role) stamping the hop events the in-process
+        # fabric would stamp in ServeFabric._reroute
+        membership.leave(victim)
+        epoch = membership.snapshot()["epoch"]
+        for i, trid, tenant, si, _ in unacked:
+            key = f"cid{9100 + si}|lg_inc|{n}x64+0"
+            d = route_decision(tenant, key, survivors, epoch=epoch)
+            assert d["shard"] in survivors
+            if REQTRACE.enabled:
+                REQTRACE.event(trid, "diverted", tenant=tenant,
+                               owner=victim, shard=d["shard"], hops=1)
+                REQTRACE.event(trid, "rerouted", tenant=tenant,
+                               from_shard=victim, to_shard=d["shard"],
+                               attempt=1)
+            reply = _rpc(procs[d["shard"]], {
+                "op": "run", "rid": i, "trace_rid": trid,
+                "tenant": tenant, "sig": si, "iters": 1})
+            assert reply is not None and reply["op"] == "done", reply
+            acked[i] = d["shard"]
+        assert sorted(acked) == [w[0] for w in work], "lost/dup rids"
+
+        # bit-exactness on every survivor
+        for m in survivors:
+            applied: dict = {}
+            for i, trid, tenant, si, _ in work:
+                if acked[i] == m:
+                    applied[si] = applied.get(si, 0) + 1
+            for si, count in applied.items():
+                v = _rpc(procs[m], {"op": "value", "sig": si})
+                assert v["uniform"], f"torn array on {m} sig {si}"
+                assert v["value"] == float(count), (m, si, v, count)
+
+        # gather every surviving process's reqtrace ring (the victim's
+        # died with it — the chain must still read whole) and merge
+        parent_rows = [
+            [e.t, e.rid, e.kind, e.fields]
+            for e in REQTRACE.snapshot()
+            if e.t >= t_wall0 and e.rid.startswith("rkill-")
+        ]
+        per_proc = [parent_rows]
+        for m in survivors:
+            r = _rpc(procs[m], {"op": "reqtrace"})
+            assert r is not None and r["op"] == "reqtrace"
+            per_proc.append(r["events"])
+        for m in survivors:
+            assert _rpc(procs[m], {"op": "exit"}) == {"op": "bye"}
+
+        snap = ClusterSnapshot(
+            offsets=[0.0] * len(per_proc),
+            spans=[[] for _ in per_proc],
+            metrics=[{} for _ in per_proc],
+            health=[{} for _ in per_proc],
+            serving=[{} for _ in per_proc],
+            reqtrace=per_proc,
+            nproc=len(per_proc),
+        )
+        trace = merged_chrome_trace(snap)
+        req_slices = [e for e in trace["traceEvents"]
+                      if e.get("cat") == "ck-req" and e.get("ph") == "X"]
+        assert req_slices, "no request tracks in the merged trace"
+
+        all_rows = [r for rows in per_proc for r in rows]
+        records = {r["rid"]: r for r in fold_phases(all_rows)}
+        for i, trid, tenant, si, _ in unacked:
+            rec = records.get(trid)
+            assert rec is not None, f"rid {trid} missing from the fold"
+            assert rec["outcome"] == "resolved", (trid, rec["kinds"])
+            assert _subsequence(
+                rec["kinds"], ["diverted", "rerouted", "resolved"]), \
+                (trid, rec["kinds"])
+            # ONE rid → ONE merged request track: every slice of this
+            # rid (parent hop stamps + survivor lifecycle) shares a tid
+            tids = {e["tid"] for e in req_slices
+                    if (e.get("args") or {}).get("rid") == trid}
+            assert len(tids) == 1, (trid, tids)
+    finally:
+        for proc in procs.values():
+            if proc.poll() is None:
+                proc.kill()
+            proc.wait(timeout=30.0)

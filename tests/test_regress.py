@@ -39,6 +39,8 @@ HEADLINE = {
     "serve_coalesce_ratio": 4.0,
     "serve_chaos_goodput_frac": 0.9,
     "serve_chaos_p99_ms": 60.0,
+    "serve_p99_queue_frac": 0.5,
+    "serve_p99_device_frac": 0.4,
     "fabric_chaos_goodput_frac": 0.8,
     "drain_recover_ms": 900.0,
     "rejoin_converge_iters": 4.0,

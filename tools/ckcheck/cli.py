@@ -52,6 +52,10 @@ HOT_ROOTS = (
     "core.worker.Worker.dispatch_async",
     "core.worker.Worker.stream_dispatch_async",
     "obs.flight.FlightRecorder.event",
+    # the request-lifecycle append (ISSUE 19): always on, rides every
+    # serve submit/dispatch — GIL-atomic deque append, no locks, no
+    # registry traffic, same budget class as FlightRecorder.event
+    "obs.reqtrace.ReqTrace.event",
     "trace.spans.Tracer.t0",
     "trace.spans.Tracer.record",
     "trace.spans.Tracer.instant",
@@ -139,6 +143,7 @@ def repo_config() -> AnalyzerConfig:
         span_vocab=("trace.spans", "SPAN_KINDS"),
         event_vocab=("obs.flight", "EVENT_KINDS"),
         decision_vocab=("obs.decisions", "DECISION_KINDS"),
+        req_vocab=("obs.reqtrace", "REQ_EVENT_KINDS"),
     )
 
 
@@ -218,8 +223,9 @@ RULE_DOCS = {
         "recovers the trailing objects from that tail (the "
         "finalize_result contract)."),
     "undeclared-kind": (
-        "A span/flight-event/decision kind is emitted that is not "
-        "declared in SPAN_KINDS / EVENT_KINDS / DECISION_KINDS — the "
+        "A span/flight-event/decision/request-lifecycle kind is "
+        "emitted that is not declared in SPAN_KINDS / EVENT_KINDS / "
+        "DECISION_KINDS / REQ_EVENT_KINDS — the "
         "vocabulary tuples are the contract lint_obs checks the "
         "documentation against; an undeclared kind is invisible to the "
         "doc lint."),

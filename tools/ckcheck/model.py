@@ -801,12 +801,23 @@ class _FuncWalker:
                 fn.value.id in ("FLIGHT",)
             if named or (t and t.endswith(".FlightRecorder")):
                 api = "event"
+            else:
+                # the request-lifecycle recorder shares the method
+                # name; receiver disambiguates (REQTRACE singleton / a
+                # typed ReqTrace), and its kind is the SECOND
+                # positional — event(rid, kind, **fields)
+                named_r = isinstance(fn.value, ast.Name) and \
+                    fn.value.id == "REQTRACE"
+                if named_r or (t and t.endswith(".ReqTrace")):
+                    api = "reqevent"
         if api is None:
             return None
         kind = None
-        if node.args and isinstance(node.args[0], ast.Constant) \
-                and isinstance(node.args[0].value, str):
-            kind = node.args[0].value
+        kind_i = 1 if api == "reqevent" else 0
+        if len(node.args) > kind_i \
+                and isinstance(node.args[kind_i], ast.Constant) \
+                and isinstance(node.args[kind_i].value, str):
+            kind = node.args[kind_i].value
         return (api, fn.attr, kind)
 
     # -- the walk ------------------------------------------------------------

@@ -49,6 +49,7 @@ class AnalyzerConfig:
     span_vocab: tuple | None = None     # ("trace.spans", "SPAN_KINDS")
     event_vocab: tuple | None = None    # ("obs.flight", "EVENT_KINDS")
     decision_vocab: tuple | None = None  # ("obs.decisions", "DECISION_KINDS")
+    req_vocab: tuple | None = None      # ("obs.reqtrace", "REQ_EVENT_KINDS")
     # passes to run (all by default)
     passes: tuple = ("lock-order", "lockset", "hotpath", "invariant",
                      "blocking")
@@ -368,9 +369,11 @@ def pass_invariant(pkg: Package, cfg: AnalyzerConfig) -> list:
     span_kinds = _load_vocab(pkg, cfg.span_vocab)
     event_kinds = _load_vocab(pkg, cfg.event_vocab)
     decision_kinds = _load_vocab(pkg, cfg.decision_vocab)
+    req_kinds = _load_vocab(pkg, cfg.req_vocab)
     vocabs = {"span": (span_kinds, "SPAN_KINDS"),
               "event": (event_kinds, "EVENT_KINDS"),
-              "decision": (decision_kinds, "DECISION_KINDS")}
+              "decision": (decision_kinds, "DECISION_KINDS"),
+              "reqevent": (req_kinds, "REQ_EVENT_KINDS")}
     for q, fi in sorted(pkg.functions.items()):
         mod = pkg.modules.get(fi.module)
 

@@ -69,6 +69,13 @@ PURE_FUNCTIONS = (
     ("cekirdekler_tpu/serve/fabric.py",
      ("route_decision", "placement_key", "ring_points", "shard_health"),
      ("sha256",)),
+    # the request-lifecycle anatomy (ISSUE 19): everything below the
+    # REQTRACE ring — fold, percentile decomposition, Perfetto
+    # rendering — is pure over event rows, so the same code runs
+    # in-process, in /reqz, and offline on a gathered cluster snapshot
+    ("cekirdekler_tpu/obs/reqtrace.py",
+     ("fold_phases", "tail_anatomy", "phase_fracs", "tenant_percentiles",
+      "slowest_requests", "request_chrome_events", "anatomy_table"), ()),
 )
 
 #: Call roots that make a transition replay-inexact by construction.

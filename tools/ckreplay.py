@@ -25,6 +25,11 @@ made from.  This tool consumes a spilled jsonl log (``CK_DECISION_LOG``,
   raw bench, transfer floor (bound or slack, with margin), damped
   move, quantization residue, and which input bound the outcome.
   The live equivalent is the debug server's ``/decisionz``.
+  ``explain --rid <id>`` pivots to ONE request: every recorded
+  controller decision whose inputs named that rid (admission verdict,
+  coalesce wave, containment/retry, fabric route/re-route hops) in
+  seq order — the decision-side complement of the ``/reqz`` phase
+  timeline for the same rid.
 - ``demo --out log.jsonl`` records a synthetic multi-lane convergence
   (skewed lanes, a transfer-floor-bound lane, a jump-start) — the
   generator behind ``tests/fixtures_decisions/`` and the quickest way
@@ -35,6 +40,7 @@ Usage::
     python -m tools.ckreplay verify run.jsonl
     python -m tools.ckreplay whatif run.jsonl --set jump_start=off
     python -m tools.ckreplay explain run.jsonl [--cid 901] [--json]
+    python -m tools.ckreplay explain run.jsonl --rid r3f2a-1c
     python -m tools.ckreplay demo --out /tmp/demo.jsonl
 """
 
@@ -201,6 +207,39 @@ def _fmt(v, nd=3):
     return str(v)
 
 
+def render_explain_rid(doc: dict) -> str:
+    """One request's decision history as plain text (one line per
+    recorded decision, most informative output fields per kind)."""
+    kinds = ", ".join(f"{k}={n}" for k, n in sorted(doc["kinds"].items()))
+    lines = [f"request {doc['rid']}: {doc['decisions']} recorded "
+             f"decision(s){' (' + kinds + ')' if kinds else ''}"]
+    for s in doc["steps"]:
+        out, kind = s["outputs"], s["kind"]
+        if kind == "admission":
+            detail = (f"admit={_fmt(out.get('admit'))} "
+                      f"reason={out.get('reason')}")
+        elif kind == "coalesce":
+            detail = (f"picked={out.get('picked')} "
+                      f"promoted={out.get('promoted')}")
+        elif kind == "route":
+            detail = (f"shard={out.get('shard')} owner={out.get('owner')} "
+                      f"diverted={_fmt(out.get('diverted'))} "
+                      f"hops={out.get('hops')}")
+        elif kind == "retry":
+            detail = (f"retry={_fmt(out.get('retry'))} "
+                      f"delay_s={_fmt(out.get('delay_s'))} "
+                      f"reason={out.get('reason')} "
+                      f"cause={s['inputs'].get('cause')}")
+        elif kind == "containment":
+            detail = (f"mode={out.get('mode')} "
+                      f"cause={s['inputs'].get('cause')}")
+        else:
+            detail = " ".join(
+                f"{k}={_fmt(v)}" for k, v in list(out.items())[:4])
+        lines.append(f"  seq={s['seq']} {kind}: {detail}")
+    return "\n".join(lines)
+
+
 def render_explain(doc: dict) -> str:
     """The causality table as plain text (one row per lane)."""
     head = (f"split seq={doc.get('seq')} cid={doc.get('cid')} "
@@ -258,9 +297,13 @@ def main(argv=None) -> int:
                      help="max simulated iterations (default 200)")
     p_w.add_argument("--json", action="store_true")
 
-    p_e = sub.add_parser("explain", help="latest split's causality table")
+    p_e = sub.add_parser("explain", help="latest split's causality table "
+                                         "(--rid: one request's history)")
     p_e.add_argument("log")
     p_e.add_argument("--cid", type=int, default=None)
+    p_e.add_argument("--rid", default=None,
+                     help="pivot to one request id: every decision whose "
+                          "inputs named this rid, in seq order")
     p_e.add_argument("--json", action="store_true")
 
     p_d = sub.add_parser("demo", help="record a synthetic convergence log")
@@ -362,8 +405,22 @@ def main(argv=None) -> int:
         return 0
 
     if args.cmd == "explain":
-        from cekirdekler_tpu.obs.replay import explain_latest
+        from cekirdekler_tpu.obs.replay import explain_latest, explain_rid
 
+        if args.rid is not None:
+            doc = explain_rid(records, args.rid)
+            if not doc["decisions"]:
+                print(f"ckreplay: no decision in this log names rid "
+                      f"{args.rid!r} (rid-bearing records need the "
+                      "decision log armed while the request ran)",
+                      file=sys.stderr)
+                return 1
+            if args.json:
+                print(json.dumps(doc, indent=2, allow_nan=False,
+                                 default=str))
+            else:
+                print(render_explain_rid(doc))
+            return 0
         doc = explain_latest(records, cid=args.cid)
         if doc is None:
             print("ckreplay: no load-balance records "

@@ -30,6 +30,11 @@ the lint can run anywhere, including rigs where jax is broken):
   decision-provenance section, both directions (ISSUE 10;
   emitted-vs-declared is ``tools/ckcheck``'s invariant pass, same
   split as flight events).
+- **Request-lifecycle kinds.**  The ``REQ_EVENT_KINDS`` tuple in
+  ``obs/reqtrace.py`` must match the phase table in the doc's
+  request-lifecycle section, both directions (ISSUE 19; the phase
+  vocabulary IS the tail-anatomy column set, so an undocumented kind
+  is an unexplained column).
 - **Replayer registry.**  Every ``REPLAYABLE_KINDS`` entry must have a
   registered replayer in ``obs/replay.py``'s ``_REPLAYERS`` dict and
   vice versa, and ``REPLAYABLE_KINDS ∪ CONTEXT_KINDS`` must equal
@@ -65,6 +70,7 @@ DEVICE_PY = os.path.join(PKG, "trace", "device.py")
 DECISIONS_PY = os.path.join(PKG, "obs", "decisions.py")
 DEBUGSERVER_PY = os.path.join(PKG, "obs", "debugserver.py")
 REPLAY_PY = os.path.join(PKG, "obs", "replay.py")
+REQTRACE_PY = os.path.join(PKG, "obs", "reqtrace.py")
 
 #: Route-table pattern in obs/debugserver.py: `"/path": self._handler`.
 #: The index route "/" is navigation, not an endpoint contract row.
@@ -173,6 +179,11 @@ def code_device_kinds() -> set[str]:
 def code_decision_kinds() -> set[str]:
     """``DECISION_KINDS`` parsed out of obs/decisions.py."""
     return _tuple_var(DECISIONS_PY, "DECISION_KINDS")
+
+
+def code_req_kinds() -> set[str]:
+    """``REQ_EVENT_KINDS`` parsed out of obs/reqtrace.py."""
+    return _tuple_var(REQTRACE_PY, "REQ_EVENT_KINDS")
 
 
 def code_replayable_kinds(source: str | None = None) -> set[str]:
@@ -308,6 +319,12 @@ def doc_decision_kinds(doc_text: str) -> set[str]:
         "### Decision provenance")
 
 
+def doc_req_kinds(doc_text: str) -> set[str]:
+    return _doc_kind_table(
+        doc_text, r"### Request lifecycle", r"\n###? ",
+        "### Request lifecycle")
+
+
 def doc_endpoints(doc_text: str) -> set[str]:
     """First-cell backticked ``/path`` tokens of the endpoint table in
     the debug-endpoints section."""
@@ -394,6 +411,20 @@ def run() -> list[str]:
             "table but not in obs.decisions.DECISION_KINDS"
         )
 
+    code_r, doc_r = code_req_kinds(), doc_req_kinds(doc_text)
+    for kind in sorted(code_r - doc_r):
+        problems.append(
+            f"request-lifecycle kind '{kind}' is in obs.reqtrace."
+            "REQ_EVENT_KINDS but missing from the doc's request-"
+            "lifecycle phase table"
+        )
+    for kind in sorted(doc_r - code_r):
+        problems.append(
+            f"request-lifecycle kind '{kind}' is in the doc's request-"
+            "lifecycle phase table but not in obs.reqtrace."
+            "REQ_EVENT_KINDS"
+        )
+
     problems.extend(replayer_problems())
 
     code_ep, doc_ep = code_endpoints(), doc_endpoints(doc_text)
@@ -423,6 +454,7 @@ def main(argv=None) -> int:
           f"{len(code_event_kinds())} flight event kinds, "
           f"{len(code_device_kinds())} device-track kinds, "
           f"{len(code_decision_kinds())} decision kinds, "
+          f"{len(code_req_kinds())} request-lifecycle kinds, "
           f"{len(code_replayer_kinds())} replayers, "
           f"{len(code_endpoints())} debug endpoints)")
     return 0

@@ -87,6 +87,46 @@ def _percentile(sorted_vals: list, q: float) -> float:
     return float(sorted_vals[k])
 
 
+def _run_anatomy(t_wall0: float) -> dict:
+    """Fold this run's request-lifecycle events (obs/reqtrace.py) into
+    the tail-anatomy block every run result carries: the p50/p95/p99
+    per-phase decomposition plus the p99 queue/device fractions the
+    bench headline keys hoist.  The recorder ring is process-global, so
+    the fold is WALL-clock-bounded to ``t_wall0`` — earlier runs'
+    events (warmups, the chaos control) must not blend in."""
+    from cekirdekler_tpu.obs.reqtrace import (
+        REQTRACE, fold_phases, phase_fracs, tail_anatomy)
+
+    events = [e for e in REQTRACE.snapshot() if e.t >= t_wall0]
+    records = [r for r in fold_phases(events) if r["outcome"] == "resolved"]
+    anatomy = tail_anatomy(records)
+    fr: dict = {}
+    p99 = anatomy["pcts"].get("p99")
+    if p99 is not None:
+        by_rid = {r["rid"]: r for r in records}
+        fr = phase_fracs(by_rid[p99["rid"]])
+    return {
+        "anatomy": anatomy,
+        "p99_queue_frac": fr.get("queue_frac"),
+        "p99_device_frac": fr.get("device_frac"),
+    }
+
+
+def _print_anatomy(out: dict, label: str = "") -> None:
+    """Render a run result's tail-anatomy table (printed after EVERY
+    human-readable run — the per-phase answer to "where did the p99
+    millisecond budget go")."""
+    anatomy = out.get("anatomy")
+    if not isinstance(anatomy, dict) or not anatomy.get("count"):
+        return
+    from cekirdekler_tpu.obs.reqtrace import anatomy_table
+
+    suffix = f" ({label})" if label else ""
+    print(f"  -- tail anatomy{suffix} --")
+    for line in anatomy_table(anatomy).splitlines():
+        print(f"  {line}")
+
+
 #: The default seeded chaos plan (``--mode chaos``; docs/RESILIENCE.md
 #: "Serving resilience"): bounded driver-submit failures (exercises
 #: blast-radius containment + retry budgets), one lane stalling at
@@ -251,6 +291,7 @@ def run_loadgen(
                          name=f"lg-client-{ci}")
         for ci in range(clients)
     ]
+    t_wall0 = time.time()  # reqtrace fold bound (see _run_anatomy)
     t0 = time.perf_counter()
     for t in threads:
         t.start()
@@ -303,6 +344,7 @@ def run_loadgen(
                            if launches > 0 else None),
         "coalesced": launches < completed,
         "checked": checked,
+        **_run_anatomy(t_wall0),
     }
 
 
@@ -526,6 +568,7 @@ def run_fabric(
     if victim is not None:
         threads.append(threading.Thread(
             target=killer, daemon=True, name="lgf-killer"))
+    t_wall0 = time.time()  # reqtrace fold bound (see _run_anatomy)
     t0 = time.perf_counter()
     for t in threads:
         t.start()
@@ -569,6 +612,7 @@ def run_fabric(
         "goodput_rps": round(done / wall_s, 2) if wall_s > 0 else None,
         "fused_windows": int(m_windows.value - w0),
         "checked": checked,
+        **_run_anatomy(t_wall0),
     }
 
 
@@ -906,6 +950,12 @@ def loadgen_section(devices=None, clients: int = 32, tenants: int = 4,
                                if chaos["checked"] else None),
         "chaos_p99_ms": (chaos["chaos_p99_ms"]
                          if chaos["checked"] else None),
+        # the closed run's tail decomposition (obs/reqtrace.py): the
+        # p99 queue/device fractions bench.py hoists to headline keys,
+        # plus the full per-phase anatomy block embedded verbatim
+        "p99_queue_frac": closed["p99_queue_frac"],
+        "p99_device_frac": closed["p99_device_frac"],
+        "anatomy": closed["anatomy"],
         "coalesced": bool(closed["coalesced"] and opened["coalesced"]),
         "checked": bool(closed["checked"] and opened["checked"]
                         and chaos["checked"]),
@@ -983,8 +1033,18 @@ def main(argv=None) -> int:
         k: v for k, v in out.items()
         if not (k in nested and isinstance(v, dict))
     } if (args.mode in ("both", "chaos") or args.fabric > 0) else out
+    rows = {k: v for k, v in rows.items() if k != "anatomy"}
     for k, v in rows.items():
         print(f"  {k:>20}: {v}")
+    # the tail-anatomy table rides every human-readable run: top-level
+    # when the run carries one, else each nested sub-run's, labeled
+    if "anatomy" in out:
+        _print_anatomy(out)
+    else:
+        for name in nested:
+            sub = out.get(name)
+            if isinstance(sub, dict) and "anatomy" in sub:
+                _print_anatomy(sub, label=name)
     if not out.get("checked", True):
         print("  EXACTNESS CHECK FAILED", file=sys.stderr)
         return 1

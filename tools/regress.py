@@ -110,6 +110,16 @@ WATCHED_KEYS = (
     # sleep-scale faults on a contended CPU container
     ("serve_chaos_goodput_frac", (), "higher", 0.30),
     ("serve_chaos_p99_ms", (), "lower", 0.50),
+    # request-lifecycle tail anatomy (ISSUE 19, inside the "serving"
+    # section): the closed-loop p99 request's wall decomposed by the
+    # reqtrace fold — fraction spent waiting to dispatch (lower is
+    # better: queueing creep is the tail regression coalescing exists
+    # to prevent) and fraction spent inside the device window (higher
+    # is better: a healthy p99 is compute-bound, not queue-bound).
+    # Floors are very wide: one request's split on a contended CPU
+    # container swings with scheduler jitter and compile warmth
+    ("serve_p99_queue_frac", (), "lower", 0.60),
+    ("serve_p99_device_frac", (), "higher", 0.60),
     # recovery tier (ISSUE 13, bench section "resilience"): wall from an
     # injected degradation's first barrier to the drain taking effect
     # (lower is better), and windows for a kill-resume run to reconverge
@@ -155,6 +165,8 @@ KEY_SECTION = {
     "serve_coalesce_ratio": "serving",
     "serve_chaos_goodput_frac": "serving",
     "serve_chaos_p99_ms": "serving",
+    "serve_p99_queue_frac": "serving",
+    "serve_p99_device_frac": "serving",
     "drain_recover_ms": "resilience",
     "rejoin_converge_iters": "resilience",
     "fabric_chaos_goodput_frac": "serving_fabric",
