@@ -710,7 +710,12 @@ RESERVED_SECTIONS = {"flash_train": 360.0, "marker_overhead": 60.0,
                      # the persistent executable cache (ISSUE 18):
                      # subprocess cold/populate/warm trio minting the
                      # regression-watched cold_start_warm_speedup
-                     "cold_start": 60.0}
+                     "cold_start": 60.0,
+                     # heterogeneous lanes (ISSUE 20): {fast-only,
+                     # slow-only, mixed, mixed-prior-off} arms at equal
+                     # total range minting the regression-watched,
+                     # exactness-gated hetero_speedup_vs_best_homog
+                     "hetero": 60.0}
 
 #: Must-run slice granted to a fairness-rotation promotion (a section
 #: budget-starved 2 rounds running) — big enough for every current
@@ -1154,6 +1159,17 @@ def main() -> None:
             devs,
             resilience=resilience if isinstance(resilience, dict) else None))
 
+    # Heterogeneous lanes (ISSUE 20): one Cores over fast + slow device
+    # kinds vs each homogeneous subset at equal total range.  On an
+    # accelerator rig the arms run real mixed silicon; on the CPU-only
+    # container the kind/prior skew is emulated (seeded slow-link fault
+    # keeps the slow lane honestly slow to the measurement plane) and
+    # the headline wall comes from the rate model at each arm's actual
+    # converged split.  Mints hetero_speedup_vs_best_homog, exactness-
+    # gated on bit-identical digests across all four arms.
+    hetero = section(
+        "hetero", lambda: _load_tool("hetero_sweep").hetero_section(devs))
+
     # Balancer on the 8-device rig with skewed per-range load (r2 #4).
     rig = section("balancer_rig", balancer_rig_section)
 
@@ -1239,6 +1255,7 @@ def main() -> None:
         "serving_fabric": serving_fabric,
         "resilience": resilience,
         "cold_start": cold_start,
+        "hetero": hetero,
         "nbody_note": (
             "nbody_gpairs_per_sec = sync-per-call variant (host fence "
             "every iteration, RTT-bound — a dispatch-latency metric); "
@@ -1411,6 +1428,15 @@ def main() -> None:
             "cold_start_warm_speedup": (
                 cold_start.get("cold_start_warm_speedup")
                 if isinstance(cold_start, dict) and cold_start.get("exact")
+                else None
+            ),
+            # the heterogeneous-lane headline (ISSUE 20): mixed-fleet
+            # wall vs the best homogeneous subset at equal total range,
+            # exactness-gated — any digest divergence across the four
+            # arms reports None (the sentinel treats it as STARVED)
+            "hetero_speedup_vs_best_homog": (
+                hetero.get("hetero_speedup_vs_best_homog")
+                if isinstance(hetero, dict) and hetero.get("exact")
                 else None
             ),
             "dtype_cells": (

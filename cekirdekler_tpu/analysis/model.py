@@ -1239,11 +1239,22 @@ class BalanceMachine(MachineBase):
     max(range_i, step)``.  Records are the REAL ``load-balance``
     decisions the live emission site produced under capture — a
     counterexample trace renders in ``ckreplay explain`` and replays
-    in ``ckreplay verify`` with no translation."""
+    in ``ckreplay verify`` with no translation.
+
+    The ``prior`` knob (ISSUE 20) seeds a trajectory's first split from
+    ``prior_split`` with effective-rate-true priors (the transfer floor
+    folded in, exactly the information the floor hands the balancer)
+    instead of the equal split, and the rate alphabet carries a
+    100x-skew kind pair — the TPU-vs-host-CPU shape.  The
+    ``prior-seeded-jump-within-one-step`` invariant then demands every
+    prior-seeded iteration stay within one quantization step of the
+    rate-implied split: the seed is already right, so no re-shard
+    churn is ever legal."""
 
     name = "balance"
     checks = ("range-conservation", "range-quantized", "jump-one-shot",
-              "freeze-legal", "converges")
+              "freeze-legal", "converges",
+              "prior-seeded-jump-within-one-step")
 
     #: Consecutive no-move iterations that close a trajectory as
     #: converged — the observable-decision settle rule (the whatif
@@ -1253,9 +1264,9 @@ class BalanceMachine(MachineBase):
     #: convergence criterion; stable ranges are.
     SETTLE = 6
 
-    def __init__(self, rate_alphabet=(1.0, 2.0, 5.0, 8.0),
+    def __init__(self, rate_alphabet=(1.0, 2.0, 5.0, 8.0, 100.0),
                  lane_counts=(2, 3), total: int = 3072, step: int = 128,
-                 horizon: int = 48, balance=None):
+                 horizon: int = 48, balance=None, seeder=None):
         from ..core import balance as B
 
         self.invariants = B.MODEL_INVARIANTS
@@ -1267,6 +1278,14 @@ class BalanceMachine(MachineBase):
         self.step = int(step)
         self.horizon = int(horizon)
         self.balance = balance or B.load_balance
+        #: the prior-on first-split function (the broken-fixture seam:
+        #: an equal-split seeder is "prior seeding filed off")
+        self.seeder = seeder or B.prior_split
+        # one CLI machine runs one BalanceMachine per lane-count band
+        # at tier-1 — per-instance names keep their reports from
+        # colliding in check_machine's sub_machines map
+        self.name = "balance(lanes={})".format(
+            ",".join(str(n) for n in self.lane_counts))
 
     def configs(self):
         out = []
@@ -1278,11 +1297,22 @@ class BalanceMachine(MachineBase):
                 for jump in (False, True):
                     for smooth in (False, True):
                         for floor in (False, True):
-                            out.append({
-                                "rates": tuple(rates), "jump": jump,
-                                "smooth": smooth, "floor": floor,
-                            })
+                            for prior in (False, True):
+                                out.append({
+                                    "rates": tuple(rates), "jump": jump,
+                                    "smooth": smooth, "floor": floor,
+                                    "prior": prior,
+                                })
         return out
+
+    def _densities(self, cfg):
+        """Effective per-item cost densities: the transfer floor
+        doubles lane 0's density (its link is 2x its compute in this
+        model), so prior/implied math sees the same wall the balancer
+        does."""
+        return [cfg["rates"][i] * (2.0 if cfg["floor"] and i == 0
+                                   else 1.0)
+                for i in range(len(cfg["rates"]))]
 
     def _benches(self, cfg, ranges):
         return [cfg["rates"][i] * max(ranges[i], self.step)
@@ -1328,13 +1358,23 @@ class BalanceMachine(MachineBase):
         with _captured():
             for cfg_idx, cfg in enumerate(self.configs()):
                 n = len(cfg["rates"])
-                ranges = B.equal_split(self.total, n, self.step)
+                trace: list[dict] = []
+                dens = self._densities(cfg)
+                inv_d = [1.0 / d for d in dens]
+                implied = [self.total * inv_d[i] / sum(inv_d)
+                           for i in range(n)]
+                if cfg["prior"]:
+                    mark = _last_seq()
+                    ranges = self.seeder(self.total, self.step,
+                                         list(inv_d), cid=cfg_idx)
+                    trace.extend(_harvest(mark))
+                else:
+                    ranges = B.equal_split(self.total, n, self.step)
                 state = B.BalanceState()
                 state.reset(ranges, B.DAMPING)
                 hist = (B.BalanceHistory(weighted=True)
                         if cfg["smooth"] else None)
                 seen = {self._canon(cfg_idx, ranges, state, hist): 0}
-                trace: list[dict] = []
                 last_change = 0
                 settled = False
                 aborted = False
@@ -1350,7 +1390,9 @@ class BalanceMachine(MachineBase):
                         self.total, self.step, hist,
                         state=state,
                         transfer_ms=self._transfer(cfg, ranges),
-                        jump_start=cfg["jump"], cid=cfg_idx)
+                        jump_start=cfg["jump"], cid=cfg_idx,
+                        rate_prior=(list(inv_d) if cfg["prior"]
+                                    else None))
                     rows = _harvest(mark)
                     trace.extend(rows)
                     row = rows[-1] if rows else {"outputs": {}}
@@ -1399,6 +1441,24 @@ class BalanceMachine(MachineBase):
                             dict(doc, ranges=list(new)), trace)
                         aborted = True
                         break
+                    if cfg["prior"]:
+                        self._hit("prior-seeded-jump-within-one-step")
+                        off = [i for i in range(n)
+                               if abs(new[i] - implied[i]) > self.step]
+                        if off:
+                            _violate(
+                                "prior-seeded-jump-within-one-step",
+                                f"iteration {it} moved lane(s) {off} "
+                                f"beyond one step ({self.step}) of the "
+                                f"rate-implied split "
+                                f"{[round(x, 1) for x in implied]}: "
+                                f"{new} (rates {cfg['rates']}, "
+                                f"floor={cfg['floor']}) — the prior "
+                                "seed was already right; this is the "
+                                "re-shard churn it exists to prevent",
+                                dict(doc, ranges=list(new)), trace)
+                            aborted = True
+                            break
                     if new != list(ranges):
                         last_change = it
                     ranges = new
@@ -2094,9 +2154,17 @@ def build_machines(name: str, quick: bool = False,
         if quick:
             return [BalanceMachine(rate_alphabet=(1.0, 5.0),
                                    lane_counts=(2,), horizon=32)]
-        rates = (1.0, 1.5, 2.0, 5.0, 8.0) if scale == 1 else \
-            (1.0, 1.5, 2.0, 3.0, 5.0, 8.0)
-        return [BalanceMachine(rate_alphabet=rates,
+        rates = (1.0, 1.5, 2.0, 5.0, 8.0, 100.0) if scale == 1 else \
+            (1.0, 1.5, 2.0, 3.0, 5.0, 8.0, 100.0)
+        # full pairwise alphabet on 2 lanes; the 3-lane machine keeps
+        # the closest tie-band pair (1.0/1.5) and the 100x hetero skew
+        # but drops the mid rates — the prior knob doubled the config
+        # space and triple-lane combos dominate the wall otherwise
+        tri = (1.0, 1.5, 2.0, 100.0) if scale == 1 else \
+            (1.0, 1.5, 2.0, 8.0, 100.0)
+        return [BalanceMachine(rate_alphabet=rates, lane_counts=(2,),
+                               horizon=32 * scale),
+                BalanceMachine(rate_alphabet=tri, lane_counts=(3,),
                                horizon=32 * scale)]
     if name == "resilience":
         if quick:

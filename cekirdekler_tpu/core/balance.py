@@ -33,6 +33,7 @@ __all__ = [
     "BalanceHistory",
     "BalanceState",
     "equal_split",
+    "prior_split",
     "per_iteration_benches",
     "DAMPING",
     "HISTORY_DEPTH",
@@ -93,6 +94,12 @@ MODEL_INVARIANTS = (
      "for every rate-consistent trajectory in the alphabet the split "
      "settles within the bound and stays — no limit cycle survives "
      "the adaptive damping + quantization freeze"),
+    ("prior-seeded-jump-within-one-step", "safety",
+     "a trajectory seeded from prior_split with rate-true priors "
+     "keeps every lane within one quantization step of the "
+     "rate-implied split from the very first rebalance on — the "
+     "heterogeneous-fleet contract: a 100x-slower host lane seeded "
+     "by its prior never drags multi-iteration re-shard churn"),
 )
 
 
@@ -202,6 +209,64 @@ def equal_split(total: int, num: int, step: int) -> list[int]:
     return ranges
 
 
+def prior_split(
+    total: int,
+    step: int,
+    priors: list[float],
+    cid: int | None = None,
+) -> list[int]:
+    """Prior-weighted first split in step quanta — the heterogeneous
+    analogue of :func:`equal_split` (ISSUE 20).
+
+    ``priors`` are relative THROUGHPUT weights, one per lane
+    (``hardware.rate_prior`` per device kind: host CPU == 1.0, every
+    accelerator some multiple).  Shares are ``prior_i / Σpriors``,
+    quantized by largest remainder: each lane floors to a ``step``
+    multiple and the leftover quanta go to the largest fractional
+    remainders (ties broken by higher prior, then lower index), so
+    EVERY lane lands strictly within one step of its continuous share
+    — the bound the ``prior-seeded-jump-within-one-step`` model
+    invariant builds on.  Equal priors reproduce :func:`equal_split`
+    exactly (the homogeneous degenerate case is bit-identical, so a
+    same-kind fleet's decision history does not change shape).
+
+    Pure and replayable: one ``prior-split`` decision record with the
+    complete inputs (``obs/replay.py`` re-executes it bit-identically;
+    the recorded priors are what ``ckreplay whatif --set
+    rate_prior=off`` removes to quantify the seeding win offline).
+    """
+    if step <= 0:
+        raise ValueError("step must be positive")
+    if total % step != 0:
+        raise ValueError(f"total range {total} not divisible by step {step}")
+    num = len(priors)
+    if num == 0:
+        raise ValueError("prior_split needs at least one lane prior")
+    safe = [max(float(p), 1e-9) for p in priors]
+    tot_p = sum(safe)
+    shares = [p / tot_p for p in safe]
+    units = total // step
+    cont = [units * s for s in shares]
+    base = [int(c) for c in cont]
+    leftover = units - sum(base)
+    # largest remainder; ties → higher prior, then lower lane index
+    order = sorted(
+        range(num), key=lambda i: (-(cont[i] - base[i]), -safe[i], i))
+    for i in order[:leftover]:
+        base[i] += 1
+    ranges = [b * step for b in base]
+    if DECISIONS.enabled:
+        DECISIONS.record("prior-split", {
+            "total": int(total), "step": int(step),
+            "priors": [float(p) for p in priors],
+            "cid": cid,
+        }, {
+            "ranges": [int(r) for r in ranges],
+            "shares": list(shares),
+        })
+    return ranges
+
+
 def load_balance(
     benchmarks: list[float],
     ranges: list[int],
@@ -214,6 +279,7 @@ def load_balance(
     transfer_ms: list[float] | None = None,
     jump_start: bool = False,
     cid: int | None = None,
+    rate_prior: list[float] | None = None,
 ) -> list[int]:
     """One balancer iteration; returns new per-chip ranges summing to
     ``total``, each a multiple of ``step`` (≥ 0).
@@ -257,6 +323,15 @@ def load_balance(
     carried into the decision record so replay/what-if can chain one
     id's sequence (the math never reads it).
 
+    ``rate_prior`` — provenance only, like ``cid``: the per-lane
+    throughput priors that seeded this chain's FIRST split
+    (:func:`prior_split`; ``None`` for an equal-split chain).  The math
+    never reads it — the prior's entire effect is the starting ranges —
+    but recording it on every iteration lets ``ckreplay whatif --set
+    rate_prior=off`` rebuild the counterfactual equal-split chain from
+    the log alone, and keeps replay-verify bit-identical (a recorded
+    input, not a behavior change).
+
     Every iteration records one ``load-balance`` decision into
     ``obs.decisions.DECISIONS`` with the COMPLETE inputs (benches,
     ranges, floors, damping, and the pre-call history/carry/state
@@ -280,6 +355,8 @@ def load_balance(
                             else [float(t) for t in transfer_ms]),
             "jump_start": bool(jump_start),
             "cid": cid,
+            "rate_prior": (None if rate_prior is None
+                           else [float(p) for p in rate_prior]),
             "history": None if history is None else {
                 "depth": int(history.depth),
                 "weighted": bool(history.weighted),

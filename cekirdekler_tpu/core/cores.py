@@ -42,7 +42,7 @@ from ..errors import (
     InjectedFaultError,
     KernelVerifyError,
 )
-from ..hardware import Devices
+from ..hardware import Devices, rate_prior
 from ..kernel.registry import KernelProgram
 from ..metrics.registry import REGISTRY
 from ..obs.debugserver import DEBUG_PORT_ENV
@@ -59,6 +59,7 @@ from .balance import (
     equal_split,
     load_balance,
     per_iteration_benches,
+    prior_split,
 )
 from .compilecache import CACHE as COMPILE_CACHE
 from .stream import TransferTuner, chunk_plan
@@ -153,6 +154,31 @@ class Cores:
         if COMPILE_CACHE.enabled:
             COMPILE_CACHE.arm()
         self.workers = [Worker(d.jax_device, i) for i, d in enumerate(devices)]
+        # heterogeneous lanes (ISSUE 20): each lane's device KIND and
+        # its table-derived relative-rate prior (hardware.rate_prior).
+        # A mixed TPU + host-CPU fleet seeds its FIRST split from these
+        # priors (prior_split in _ranges_for) instead of the equal
+        # split, so the 10-100x-slower host lane starts near its
+        # rate-implied share and the measured balancer only has to trim
+        # — not rescue — the partition.  Both are plain attributes:
+        # tools emulating a mixed fleet on virtual lanes (hetero_sweep,
+        # resilience scenarios) overwrite rate_priors the same way they
+        # pin fixed_compute_powers.  Homogeneous fleets see equal
+        # priors, which _skewed_priors collapses to None — decision
+        # logs and splits stay bit-identical to the pre-prior behavior.
+        self.lane_kinds: list[str] = [
+            str(getattr(d.jax_device, "device_kind",
+                        d.jax_device.platform))
+            for d in devices
+        ]
+        self.rate_priors: list[float] = [
+            rate_prior(k) for k in self.lane_kinds]
+        for i, kind in enumerate(self.lane_kinds):
+            REGISTRY.gauge(
+                "ck_lane_rate_prior",
+                "table-derived relative-rate prior per lane",
+                lane=i, ck_lane_kind=kind,
+            ).set(self.rate_priors[i])
         self.pool = ThreadPoolExecutor(max_workers=max(1, len(self.workers)))
         # per-compute-id state (reference: Cores.cs:130-135)
         self.global_ranges: dict[int, list[int]] = {}
@@ -420,6 +446,18 @@ class Cores:
         return [d.name for d in self.devices]
 
     # -- range tables --------------------------------------------------------
+    def _skewed_priors(self) -> list[float] | None:
+        """The lane rate priors, or ``None`` when they carry no signal
+        (homogeneous fleet / stale length after a device-set edit).
+        ``None`` keeps every homogeneous split and decision record
+        bit-identical to the pre-prior behavior — the prior path only
+        engages when the fleet actually mixes device kinds."""
+        pr = self.rate_priors
+        if (pr and len(pr) == self.num_devices
+                and len(set(float(p) for p in pr)) > 1):
+            return [float(p) for p in pr]
+        return None
+
     def _ranges_for(
         self, compute_id: int, total: int, step: int, rebalance: bool
     ) -> tuple[list[int], list[int]]:
@@ -438,7 +476,15 @@ class Cores:
                     ranges[i] += step if diff > 0 else -step
                     diff = total - sum(ranges)
             else:
-                ranges = equal_split(total, n, step)
+                priors = self._skewed_priors()
+                if priors is not None and n > 1:
+                    # prior-seeded first split (ISSUE 20): land near the
+                    # rate-implied share immediately; the measured
+                    # balancer refines from there
+                    ranges = prior_split(total, step, priors,
+                                         cid=compute_id)
+                else:
+                    ranges = equal_split(total, n, step)
         elif rebalance and n > 1 and self.fixed_compute_powers is None:
             # ckcheck: ok racy bench read — staleness tolerated by the
             # balancer (decay/refresh converge it); writers hold w.lock
@@ -468,11 +514,13 @@ class Cores:
                         bench, ranges, total, step, hist, state=state,
                         transfer_ms=transfer, jump_start=True,
                         cid=compute_id,
+                        rate_prior=self._skewed_priors(),
                     )
                 else:
                     carry = self._cont_ranges.setdefault(compute_id, [])
                     ranges = load_balance(bench, ranges, total, step, hist,
-                                          carry=carry, cid=compute_id)
+                                          carry=carry, cid=compute_id,
+                                          rate_prior=self._skewed_priors())
         # drain mask (obs/drain.py): quarantined lanes hold 0, probation
         # lanes hold exactly one probe step, displaced share moves to
         # the actives — applied to CACHED tables too (idempotent), so a
@@ -1386,6 +1434,9 @@ class Cores:
 
         hits = misses = 0
         keys: list[str] = []
+        # per-device-kind ladder count: the mixed-fleet warmup proof —
+        # every kind present in the lane set gets its own AOT pass
+        kinds: dict[str, int] = {}
         for spec in specs:
             step = spec.local_range
             units = spec.global_range // step
@@ -1397,6 +1448,7 @@ class Cores:
                 return tuple(_v)
 
             for platform, donate, device_kind, device in self._warm_targets():
+                kinds[device_kind] = kinds.get(device_kind, 0) + 1
                 key = None
                 hit = False
                 if CACHE.enabled:
@@ -1446,7 +1498,7 @@ class Cores:
         FLIGHT.event(
             "cache-warmup", warmed=len(specs), hits=hits, misses=misses,
             skipped=skipped, wall_ms=round(wall_s * 1e3, 3),
-            cache=CACHE.enabled,
+            cache=CACHE.enabled, kinds=dict(kinds),
         )
         if DECISIONS.enabled:
             # context record (reads the filesystem: provenance, not
@@ -1459,9 +1511,11 @@ class Cores:
                 "warmed": len(specs), "hits": hits, "misses": misses,
                 "skipped": skipped, "keys": keys,
                 "wall_ms": round(wall_s * 1e3, 3),
+                "kinds": dict(kinds),
             })
         return {"warmed": len(specs), "hits": hits, "misses": misses,
-                "skipped": skipped, "wall_s": wall_s}
+                "skipped": skipped, "wall_s": wall_s,
+                "kinds": dict(kinds)}
 
     def _cache_record_engaged(self, run: _FusedRun) -> None:
         """Persist an engaged window's ladder spec so OTHER processes

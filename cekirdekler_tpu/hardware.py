@@ -31,6 +31,10 @@ __all__ = [
     "all_devices",
     "DEVICE_PEAKS",
     "device_peaks",
+    "HOST_PEAKS",
+    "RATE_PRIORS",
+    "rate_prior",
+    "device_rank",
 ]
 
 
@@ -54,6 +58,63 @@ DEVICE_PEAKS: dict[str, tuple[float, float]] = {
 
 #: The fallback kind (and the historical default): TPU v5e.
 DEFAULT_PEAK_KIND = "TPU v5e"
+
+#: Host-CPU peaks in the same (Tflop/s, GB/s) shape as
+#: :data:`DEVICE_PEAKS` — a few DDR channels' streaming bandwidth, the
+#: anchor every accelerator prior is expressed against.  Keyed on the
+#: kinds XLA:CPU actually reports (``jax.Device.device_kind`` is
+#: ``"cpu"`` on the host backend).
+HOST_PEAKS: dict[str, tuple[float, float]] = {
+    "cpu": (1.0, 50.0),
+    "host": (1.0, 50.0),
+}
+
+#: The host-CPU anchor kind (prior == 1.0 by construction).
+HOST_PRIOR_KIND = "cpu"
+
+#: Device-kind → relative throughput prior for BANDWIDTH-BOUND work,
+#: normalized to host CPU == 1.0.  Derived from the SAME peak tables
+#: that drive roofline/MFU (:data:`DEVICE_PEAKS`) — the ISSUE 20 rule:
+#: ranking (:func:`device_rank`) and the balancer's seed
+#: (:func:`rate_prior`) read ONE table, so they cannot drift apart.
+#: The mixed-fleet balancer seeds its first split from these ratios
+#: (``core/balance.prior_split``) instead of discovering a ~25x-slower
+#: host lane from equal shares over many re-shard iterations.
+RATE_PRIORS: dict[str, float] = {
+    kind: round(gb / HOST_PEAKS["cpu"][1], 3)
+    for kind, (_tf, gb) in {**DEVICE_PEAKS, **HOST_PEAKS}.items()
+}
+
+
+def rate_prior(device_kind: str) -> float:
+    """Relative throughput prior for one device kind (host CPU == 1.0).
+
+    Pure over :data:`RATE_PRIORS` (model-checked purity contract:
+    ``tools/ckmodel/purity.py``) — no jax, no clock, no environment.
+    Unknown kinds resolve the way :func:`device_peaks` does: anything
+    CPU/host-flavored anchors at the host prior, anything else falls
+    back to the :data:`DEFAULT_PEAK_KIND` accelerator prior, so an
+    unrecognized chip is at least seeded as "an accelerator", never as
+    a host lane."""
+    kind = str(device_kind)
+    if kind in RATE_PRIORS:
+        return RATE_PRIORS[kind]
+    low = kind.lower()
+    if "cpu" in low or "host" in low:
+        return RATE_PRIORS[HOST_PRIOR_KIND]
+    return RATE_PRIORS[DEFAULT_PEAK_KIND]
+
+
+def device_rank(device_kind: str) -> int:
+    """Rank of a device kind by descending prior (0 == fastest band).
+
+    The machine-readable face of the
+    ``devicesWithHighestDirectNbodyPerformance`` idiom: kinds sharing a
+    prior share a rank band.  Reads the SAME table as
+    :func:`rate_prior`, so the ranking a selector sorts by and the seed
+    the balancer splits by cannot disagree."""
+    p = rate_prior(device_kind)
+    return sum(1 for v in set(RATE_PRIORS.values()) if v > p)
 
 
 def device_peaks(device_kind: str | None = None) -> tuple[float, float, str]:

@@ -146,6 +146,27 @@ class KernelProgram:
                 1 for k in self._cache if k and k[0] == "fused" and len(k) == 9
             )
 
+    def compiled_counts_by_platform(self) -> dict[str, int]:
+        """Distinct cached launch executables per dispatch platform —
+        the heterogeneous-fleet compile-isolation probe: every launcher
+        cache key carries its platform (plain/seq/fused alike), so a
+        host-CPU lane joining a TPU fleet grows only the ``"cpu"``
+        count while the ``"tpu"`` count stays PINNED — one kind can
+        never evict or re-trace another kind's executables."""
+        with self._lock:
+            out: dict[str, int] = {}
+            for k in self._cache:
+                if k and k[0] == "fused" and len(k) == 9:
+                    p = k[7]
+                elif k and k[0] == "seq" and len(k) == 9:
+                    p = k[8]
+                elif len(k) == 5:
+                    p = k[4]
+                else:  # future key shape: never miscount, bucket as ?
+                    p = "?"
+                out[str(p)] = out.get(str(p), 0) + 1
+        return out
+
     def __contains__(self, name: str) -> bool:
         return name in self._c_kernels or name in self._py_kernels
 

@@ -119,6 +119,7 @@ DECISION_KINDS = (
     "block-retune",        # core/blocktuner — tile/block choice engaged/moved
     "route",               # serve/fabric — one shard-placement verdict
     "cache-warmup",        # core/cores.warmup — one AOT plan warmed (key set)
+    "prior-split",         # core/balance.prior_split — prior-seeded first split
 )
 
 #: The subset replay-verify re-executes: decisions that are pure
@@ -130,7 +131,7 @@ REPLAYABLE_KINDS = (
     "admission", "coalesce",
     "breaker", "shed", "retry", "containment",
     "drain-apply", "readmit", "member-leave", "member-join",
-    "block-retune", "route",
+    "block-retune", "route", "prior-split",
 )
 
 #: The complement, DECLARED: every decision kind is placed in exactly

@@ -80,6 +80,9 @@ WHATIF_KNOBS = {
     "smoothing": "sliding-window share smoothing (on/off)",
     "overhead_ms": "transfer tuner per-chunk overhead (float; replays "
                    "every transfer-choose with this lane overhead)",
+    "rate_prior": "prior-seeded first split (on/off; off restarts the "
+                  "chain from the equal split, quantifying what the "
+                  "device-kind priors saved)",
     "block_grid": "block tuner candidate tile sizes, x-separated (e.g. "
                   "128x256x512; replays every block-retune with the "
                   "legal grid rebuilt from these candidates)",
@@ -192,6 +195,8 @@ def _replay_load_balance(inp: dict, out: dict) -> dict:
                      else [float(t) for t in inp["transfer_ms"]]),
         jump_start=bool(inp.get("jump_start", False)),
         cid=inp.get("cid"),
+        rate_prior=(None if inp.get("rate_prior") is None
+                    else [float(p) for p in inp["rate_prior"]]),
     )
     mism: dict = {}
     exp = [int(x) for x in out.get("ranges", ())]
@@ -210,6 +215,20 @@ def _replay_load_balance(inp: dict, out: dict) -> dict:
             if gv != ev:
                 mism[f"state_after.{k}"] = {"expected": ev, "got": gv}
     return mism
+
+
+def _replay_prior_split(inp: dict, out: dict) -> dict:
+    from ..core import balance as B
+
+    got = B.prior_split(
+        int(inp["total"]), int(inp["step"]),
+        [float(p) for p in inp["priors"]],
+        cid=inp.get("cid"),
+    )
+    exp = [int(x) for x in out.get("ranges", ())]
+    if got != exp:
+        return {"ranges": {"expected": exp, "got": got}}
+    return {}
 
 
 def _mk_tuner(inp):
@@ -527,6 +546,7 @@ _REPLAYERS = {
     "member-join": _replay_member,
     "block-retune": _replay_block_retune,
     "route": _replay_route,
+    "prior-split": _replay_prior_split,
 }
 assert set(_REPLAYERS) == set(REPLAYABLE_KINDS)
 
@@ -709,7 +729,20 @@ def simulate_balance(recs: list[dict], overrides: dict | None = None,
     elif first.get("carry") is not None:
         carry = list(first["carry"])
 
-    ranges = [int(r) for r in first["ranges"]]
+    # the prior's entire effect is the chain's STARTING ranges (the
+    # recorded first split is the prior-seeded one when the log carries
+    # a rate_prior input) — so the off-counterfactual restarts the
+    # chain from the equal split with fresh continuous state, exactly
+    # the pre-ISSUE-20 first window
+    prior_on = bool(overrides.get("rate_prior", True))
+    if prior_on:
+        ranges = [int(r) for r in first["ranges"]]
+    else:
+        ranges = B.equal_split(total, n, step)
+        if state is not None:
+            state.reset(ranges, damping)
+        elif carry is not None:
+            carry = None
     trajectory = [list(ranges)]
     last_change = 0
     it = 0

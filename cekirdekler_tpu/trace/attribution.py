@@ -84,6 +84,10 @@ class AttributionReport:
     wall_ms: float
     per_kind: dict = field(default_factory=dict)      # kind -> {ms, count}
     per_cid: dict = field(default_factory=dict)       # cid -> {kind: ms}
+    # device kind -> {ms, count, lanes} (heterogeneous fleets, ISSUE
+    # 20): only populated when the caller passes lane_kinds — the span
+    # ring carries lane INDICES, the scheduler owns the index→kind map
+    per_lane_kind: dict = field(default_factory=dict)
     covered_ms: float = 0.0    # union of span intervals (wall coverage)
     gap_ms: float = 0.0        # wall - covered: host time no span explains
     device_busy_ms: float | None = None   # from utils/timeline.py, if given
@@ -117,6 +121,13 @@ class AttributionReport:
                 str(cid): {k: round(ms, 3) for k, ms in kinds.items()}
                 for cid, kinds in sorted(self.per_cid.items())
             },
+            "per_lane_kind": {
+                k: {"ms": round(v["ms"], 3), "count": v["count"],
+                    "lanes": sorted(v["lanes"])}
+                for k, v in sorted(
+                    self.per_lane_kind.items(), key=lambda kv: -kv[1]["ms"]
+                )
+            },
             "n_spans": self.n_spans,
             "ring_wrapped": self.ring_wrapped,
             "dropped_spans": self.dropped_spans,
@@ -140,6 +151,15 @@ class AttributionReport:
             lines.append(
                 f"{kind:>16} {v['ms']:12.3f} {v['count']:8d} {pct:8.1f}"
             )
+        if self.per_lane_kind:
+            lines.append(
+                f"{'device kind':>16} {'total ms':>12} {'count':>8} "
+                f"{'lanes':>8}")
+            for kind, v in sorted(self.per_lane_kind.items(),
+                                  key=lambda kv: -kv[1]["ms"]):
+                lines.append(
+                    f"{kind:>16} {v['ms']:12.3f} {v['count']:8d} "
+                    f"{len(v['lanes']):8d}")
         if self.ring_wrapped:
             lines.append(
                 f"(ring buffer wrapped: {self.dropped_spans} oldest spans "
@@ -155,6 +175,7 @@ def window_report(
     device_busy_ms: float | None = None,
     ring_wrapped: bool = False,
     dropped_spans: int = 0,
+    lane_kinds: dict | None = None,
 ) -> AttributionReport:
     """Account the host wall window [t0, t1] from recorded spans.
 
@@ -165,10 +186,21 @@ def window_report(
     ``dropped_spans`` (``Tracer.dropped_spans``) is how many spans the
     ring lost to wrap before this snapshot — when nonzero the report's
     totals/coverage undercount by exactly those spans, and the report
-    says so instead of letting attribution coverage silently shrink."""
+    says so instead of letting attribution coverage silently shrink.
+
+    ``lane_kinds`` maps lane index → device kind (``Cores.lane_kinds``
+    by position): when given, lane-tagged spans additionally roll up
+    per DEVICE KIND — the heterogeneous-fleet account of which silicon
+    the window's time went to (TPU vs host-CPU lanes in one Cores)."""
     wall_ms = max(t1 - t0, 0.0) * 1000.0
     per_kind: dict[str, dict] = {}
     per_cid: dict[int, dict] = {}
+    per_lane_kind: dict[str, dict] = {}
+    kind_of = {}
+    if lane_kinds:
+        kind_of = (dict(enumerate(lane_kinds))
+                   if isinstance(lane_kinds, (list, tuple))
+                   else dict(lane_kinds))
     intervals: list[tuple[float, float]] = []
     n = 0
     for s in spans:
@@ -183,6 +215,12 @@ def window_report(
         if s.cid is not None:
             per_cid.setdefault(s.cid, {}).setdefault(s.kind, 0.0)
             per_cid[s.cid][s.kind] += ms
+        if s.lane is not None and s.lane in kind_of:
+            dk = per_lane_kind.setdefault(
+                str(kind_of[s.lane]), {"ms": 0.0, "count": 0, "lanes": set()})
+            dk["ms"] += ms
+            dk["count"] += 1
+            dk["lanes"].add(int(s.lane))
         if hi > lo:
             intervals.append((lo, hi))
     covered = union_ms(intervals)
@@ -190,6 +228,7 @@ def window_report(
         wall_ms=wall_ms,
         per_kind=per_kind,
         per_cid=per_cid,
+        per_lane_kind=per_lane_kind,
         covered_ms=covered,
         gap_ms=max(wall_ms - covered, 0.0),
         device_busy_ms=device_busy_ms,

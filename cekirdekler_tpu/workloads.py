@@ -563,6 +563,7 @@ def nbody_e2e(
                 dropped_spans=TRACER.dropped_spans,
                 single_chip_partitions=single_chip_partitions,
                 fused=fused,
+                lane_kinds=list(cr.cores.lane_kinds),
             )
             if device_timeline_dir:
                 out["attribution"].update(_nbody_device_profile(
@@ -674,6 +675,7 @@ def _nbody_attribution(
     spans, t0, t_end, wall, iters, lanes, probe_devs, n, dt,
     local_range, window, probe_iters, ring_wrapped=False,
     dropped_spans=0, single_chip_partitions=False, fused=True,
+    lane_kinds=None,
 ) -> dict:
     """Name each factor of the nbody_e2e gap with a measurement
     (VERDICT r5 #3).  Fractions are of the e2e wall; they need not sum
@@ -682,7 +684,8 @@ def _nbody_attribution(
     from .trace.attribution import union_ms, window_report
 
     rep = window_report(spans, t0, t_end, ring_wrapped=ring_wrapped,
-                        dropped_spans=dropped_spans)
+                        dropped_spans=dropped_spans,
+                        lane_kinds=lane_kinds)
 
     def _kind(kind):
         # the report's window-clipped totals — the same numbers its own
@@ -756,6 +759,15 @@ def _nbody_attribution(
         },
         "per_kind_ms": {
             k: round(v["ms"], 3) for k, v in rep.per_kind.items()
+        },
+        # heterogeneous fleets (ISSUE 20): where the window's lane-
+        # tagged time went per DEVICE KIND — on a mixed TPU + host-CPU
+        # Cores this is the split's per-silicon account; homogeneous
+        # fleets see one row
+        "per_lane_kind_ms": {
+            k: {"ms": round(v["ms"], 3), "count": v["count"],
+                "lanes": sorted(v["lanes"])}
+            for k, v in rep.per_lane_kind.items()
         },
         "ring_wrapped": ring_wrapped,  # True = factors undercount
         "dropped_spans": dropped_spans,  # exactly how many spans wrapped away

@@ -384,6 +384,15 @@ def _oscillator(bench, ranges, total, step, hist, **kw):
     return out
 
 
+def _equal_seeder(total, step, priors, cid=None):
+    """Prior seeding filed off: ignores the device-kind priors and
+    hands back the equal split — from there, a 100x-skewed fleet's
+    first damped rebalance lands far outside one step of the
+    rate-implied split, which is exactly the churn the prior-seeded
+    invariant exists to forbid."""
+    return B.equal_split(int(total), len(priors), int(step))
+
+
 def _balance_machine(alphabet=(1.0, 5.0), **kw):
     return M.BalanceMachine(rate_alphabet=alphabet, lane_counts=(2,),
                             horizon=24, **kw)
@@ -678,6 +687,9 @@ BROKEN_FIXTURES = {
     "freeze-legal":
         lambda: _balance_machine(alphabet=(1.0,), balance=_freeze_mover),
     "converges": lambda: _balance_machine(balance=_oscillator),
+    "prior-seeded-jump-within-one-step":
+        lambda: _balance_machine(alphabet=(1.0, 100.0),
+                                 seeder=_equal_seeder),
     "choice-legality":
         lambda: _block_machine(decide=_illegal_block_decide),
     "hysteresis-bound":

@@ -48,6 +48,8 @@ from cekirdekler_tpu.utils.jsonsafe import json_safe
 HERE = os.path.dirname(os.path.abspath(__file__))
 ROOT = os.path.dirname(HERE)
 GOLDEN = os.path.join(HERE, "fixtures_decisions", "golden_rebalance.jsonl")
+GOLDEN_HETERO = os.path.join(
+    HERE, "fixtures_decisions", "golden_hetero_prior.jsonl")
 
 INC = """
 __kernel void inc(__global float* a) {
@@ -349,6 +351,37 @@ def test_golden_fixture_replays_bit_identically():
     assert verdict["ok"], verdict["first_divergence"]
     assert verdict["replayed"] == len(rows)
     assert verdict["first_divergence"] is None
+
+
+def test_golden_hetero_prior_fixture_replays_and_whatif_contrast():
+    """ISSUE 20 golden fixture: a prior-seeded heterogeneous chain (one
+    fast + one 100x-slow lane) replays bit-identically, the chain is
+    genuinely seeded FROM the prior-split record, and the what-if
+    counterfactual quantifies the prior's win — prior-on converges in
+    at most HALF the iterations of prior-off on the same recorded
+    rates (the acceptance bar: the seed starts the chain already at
+    the rate-implied split, so the damped iteration has nothing left
+    to move)."""
+    rows = load_decision_log(GOLDEN_HETERO)
+    assert any(r.kind == "prior-split" for r in rows)
+    verdict = replay_mod.verify_records(rows)
+    assert verdict["ok"], verdict["first_divergence"]
+    assert verdict["replayed"] == len(rows)
+    # the first balance step starts FROM the prior-split output
+    seed = next(r for r in rows if r.kind == "prior-split")
+    first_lb = next(r for r in rows if r.kind == "load-balance")
+    assert first_lb.inputs["ranges"] == seed.outputs["ranges"]
+    assert first_lb.inputs["rate_prior"] == seed.inputs["priors"]
+    # counterfactual: filing the prior off restarts from equal_split
+    wi = replay_mod.whatif(rows, {"rate_prior": False})
+    on = wi["factual"]["iterations_to_converge"]
+    off = wi["counterfactual"]["iterations_to_converge"]
+    assert wi["factual"]["converged"] and wi["counterfactual"]["converged"]
+    assert off >= 1, "prior-off control never had to move?"
+    assert on <= off / 2, (on, off)
+    # both land on the SAME split — the prior buys convergence speed,
+    # never a different answer
+    assert wi["final_split_l1"] == 0
 
 
 def test_perturbed_knob_fails_naming_first_divergent_seq(monkeypatch):

@@ -219,6 +219,31 @@ def test_seeded_stall_is_drained_and_readmitted_exactly(devs):
     assert res["exact"], res
 
 
+def test_mixed_kind_stall_quarantines_without_starving_fast_lanes(devs):
+    """Degradation containment on a heterogeneous fleet (ISSUE 20): a
+    stalled host-CPU lane in a 2-fast + 1-slow mixed Cores quarantines
+    at a barrier, the fast accelerator-kind lanes absorb its share
+    WITHOUT ever dipping below their rate-implied floor, the
+    availability floor never engages (both fast lanes stay active),
+    and the result is bit-exact.  Runs the same scenario the bench's
+    ``resilience`` section ships (tools/resilience.py)."""
+    res = _load_resilience().mixed_drain_scenario(
+        devs, stall_ms=400.0, max_windows=40)
+    assert res.get("skipped") is None, res
+    assert res["lane_kinds"] == ["tpu-emu", "tpu-emu", "cpu"]
+    assert res["windows_to_drain"] is not None, res
+    assert res["slow_lane_drained"] is True, res
+    assert res["fast_floor_ok"] is True, res
+    assert res["fast_lanes_active"] is True, res
+    # the rate-implied floor really is the prior-weighted share
+    floor = res["rate_implied_floor"]
+    assert floor[0] + floor[1] > 14 * floor[2]  # ~8x lanes vs 1x lane
+    after = res["ranges_after_drain"]
+    assert after[2] == 0 and sum(after) == sum(res["ranges_before"])
+    assert res["windows_to_readmit"] is not None, res
+    assert res["exact"], res
+
+
 # ---------------------------------------------------------------------------
 # socket drop: reconnect + idempotent retry / named exhaustion
 # ---------------------------------------------------------------------------

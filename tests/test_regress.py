@@ -45,6 +45,7 @@ HEADLINE = {
     "drain_recover_ms": 900.0,
     "rejoin_converge_iters": 4.0,
     "cold_start_warm_speedup": 20.0,
+    "hetero_speedup_vs_best_homog": 1.12,
 }
 
 
@@ -122,6 +123,35 @@ def test_null_reason_record_preferred_over_errors_map():
         _art(HEADLINE), _art(starved, sections=sections))
     assert v["exit_code"] == 3
     assert "budget_spent_s=1432.1" in v["findings"][0]["reason"]
+
+
+def test_hetero_key_watched_and_exactness_starves():
+    """ISSUE 20: hetero_speedup_vs_best_homog is regression-watched
+    (higher is better, wide 30% floor) and exactness-gated — the bench
+    nulls it whenever the four arms' digests diverge, and the sentinel
+    must surface that null as STARVED with the hetero section's reason,
+    not as a silent pass."""
+    assert any(k == "hetero_speedup_vs_best_homog"
+               for k, _a, _d, _t in regress.WATCHED_KEYS)
+    assert regress.KEY_SECTION["hetero_speedup_vs_best_homog"] == "hetero"
+    bad = dict(HEADLINE)
+    bad["hetero_speedup_vs_best_homog"] *= 0.6  # past the 30% floor
+    v = regress.diff_headlines(_art(HEADLINE), _art(bad))
+    assert not v["ok"] and v["exit_code"] == 2
+    assert [f["key"] for f in v["findings"]] == [
+        "hetero_speedup_vs_best_homog"]
+    starved = dict(HEADLINE)
+    starved["hetero_speedup_vs_best_homog"] = None
+    sections = {"hetero": {
+        "null_reason": "inexact: mixed arm digest diverged",
+        "budget_spent_s": 12.0}}
+    v = regress.diff_headlines(
+        _art(HEADLINE), _art(starved, sections=sections))
+    assert v["exit_code"] == 3
+    f = v["findings"][0]
+    assert f["kind"] == "starved"
+    assert f["key"] == "hetero_speedup_vs_best_homog"
+    assert "digest diverged" in f["reason"]
 
 
 def test_missing_headline_block_entirely_is_starved():

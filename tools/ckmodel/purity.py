@@ -47,6 +47,9 @@ REPO = os.path.dirname(
 PURE_FUNCTIONS = (
     ("cekirdekler_tpu/obs/drain.py",
      ("drain_transition", "apply_quarantine"), ()),
+    # the heterogeneous prior: rate table lookups only — a seed that
+    # read the live rig (jax, clocks) could not replay (ISSUE 20)
+    ("cekirdekler_tpu/hardware.py", ("rate_prior", "device_rank"), ()),
     ("cekirdekler_tpu/serve/admission.py", ("admit_decision",), ()),
     ("cekirdekler_tpu/serve/coalescer.py", ("plan_coalesce",), ()),
     # the serving resilience layer (breaker/shed/retry/containment):

@@ -81,7 +81,8 @@ def load_records(path: str):
 #: Per-knob value types: coercion is by KNOB, not by value shape —
 #: `overhead_ms=off` must be rejected, not silently become 0.0, and
 #: `jump_start=0.3` must not float-parse into truthy-on.
-_BOOL_KNOBS = frozenset(("jump_start", "transfer_floor", "smoothing"))
+_BOOL_KNOBS = frozenset(("jump_start", "transfer_floor", "smoothing",
+                         "rate_prior"))
 _FLOAT_KNOBS = frozenset(("damping", "overhead_ms"))
 #: x-separated int lists (``--set`` splits entries on commas, so the
 #: grid knob separates its sizes with ``x``: ``block_grid=128x256x512``).
@@ -191,6 +192,52 @@ def demo_log(path: str, lanes: int = 3, steps: int = 12,
         # from here diverges under replay when someone retunes them
         chain(0, jump=True)
         chain(1, jump=False)
+        return log.save_jsonl(path)
+    finally:
+        _dmod.DECISIONS = saved
+        _bal.DECISIONS = bal_saved
+
+
+def demo_hetero_log(path: str, total: int = 8192, step: int = 64,
+                    steps: int = 10, skew: float = 100.0) -> str:
+    """Record a prior-seeded heterogeneous chain: 1 fast + 1 slow lane
+    (``skew``x apart, the TPU-vs-host-CPU shape), first split from
+    ``prior_split`` with rate-true priors, every iteration the REAL
+    ``load_balance`` with the priors on the record.  This is the
+    ``tests/fixtures_decisions/golden_hetero_prior.jsonl`` generator:
+    the log replay-verifies by construction, and ``ckreplay whatif
+    --set rate_prior=off`` on it quantifies what the seed saved."""
+    from cekirdekler_tpu.core.balance import (
+        BalanceHistory,
+        BalanceState,
+        load_balance,
+        prior_split,
+    )
+    from cekirdekler_tpu.obs.decisions import DecisionLog
+    import cekirdekler_tpu.obs.decisions as _dmod
+    import cekirdekler_tpu.core.balance as _bal
+
+    log = DecisionLog()
+    saved = _dmod.DECISIONS
+    _dmod.DECISIONS = log
+    bal_saved = _bal.DECISIONS
+    _bal.DECISIONS = log
+    try:
+        # per-item compute rates (ms/item): lane 1 is `skew`x slower —
+        # the prior is rate-TRUE (throughput ∝ 1/rate), the ideal-seed
+        # case the prior-seeded-jump-within-one-step invariant pins
+        rates = [0.001, 0.001 * skew]
+        priors = [1.0 / r for r in rates]
+        ranges = prior_split(total, step, priors, cid=0)
+        hist = BalanceHistory(weighted=True)
+        state = BalanceState()
+        for _ in range(steps):
+            bench = [rates[i] * max(ranges[i], step)
+                     for i in range(len(ranges))]
+            ranges = load_balance(
+                bench, ranges, total, step, hist, state=state,
+                jump_start=True, cid=0, rate_prior=priors,
+            )
         return log.save_jsonl(path)
     finally:
         _dmod.DECISIONS = saved
@@ -310,11 +357,17 @@ def main(argv=None) -> int:
     p_d.add_argument("--out", default="/tmp/ck_decision_demo.jsonl")
     p_d.add_argument("--lanes", type=int, default=3)
     p_d.add_argument("--steps", type=int, default=12)
+    p_d.add_argument("--hetero", action="store_true",
+                     help="prior-seeded 1 fast + 1 slow (100x) chain "
+                          "instead (the golden_hetero_prior generator)")
 
     args = ap.parse_args(argv)
 
     if args.cmd == "demo":
-        path = demo_log(args.out, lanes=args.lanes, steps=args.steps)
+        if args.hetero:
+            path = demo_hetero_log(args.out, steps=args.steps)
+        else:
+            path = demo_log(args.out, lanes=args.lanes, steps=args.steps)
         print(f"ckreplay: demo log written to {path}")
         return 0
 

@@ -95,7 +95,11 @@ def _ast_metric_names(source: str) -> set[str]:
     """``ck_*`` literal first args of ANY ``.counter/.gauge/.histogram``
     call — receiver-agnostic on purpose: cached-handle helpers
     (``self._reg.gauge(...)``, a factory parameter) register series the
-    ``REGISTRY.``-anchored regex never sees."""
+    ``REGISTRY.``-anchored regex never sees.  ``ck_*``-prefixed LABEL
+    keys on those calls count too: a namespaced label (e.g.
+    ``ck_lane_kind`` on ``ck_lane_rate_prior``) is part of the
+    exposition surface the doc's series table documents, same as the
+    series name itself."""
     out: set[str] = set()
     try:
         tree = ast.parse(source)
@@ -112,6 +116,9 @@ def _ast_metric_names(source: str) -> set[str]:
             and node.args[0].value.startswith("ck_")
         ):
             out.add(node.args[0].value)
+            for kw in node.keywords:
+                if kw.arg and kw.arg.startswith("ck_"):
+                    out.add(kw.arg)
     return out
 
 

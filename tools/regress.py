@@ -141,6 +141,15 @@ WATCHED_KEYS = (
     # not bit-invisible).  Floor is wide: the numerator is one
     # subprocess's XLA compile wall on a contended CPU container
     ("cold_start_warm_speedup", (), "higher", 0.50),
+    # heterogeneous lanes (ISSUE 20, bench section "hetero"): mixed
+    # fast+slow fleet wall vs the best homogeneous subset at equal total
+    # range (higher is better; exactness-gated to None unless all four
+    # arms' result digests are bit-identical — a mixed fleet that
+    # corrupts results must starve the key, never ship a speedup).
+    # Floor is wide: on the CPU-only container the wall is the rate
+    # model at each arm's converged split, but the splits themselves
+    # ride measured benches under injected slow-link faults
+    ("hetero_speedup_vs_best_homog", (), "higher", 0.30),
 )
 
 #: Trajectory-noise widening: tolerance = max(floor, NOISE_K * CV).
@@ -171,6 +180,7 @@ KEY_SECTION = {
     "rejoin_converge_iters": "resilience",
     "fabric_chaos_goodput_frac": "serving_fabric",
     "cold_start_warm_speedup": "cold_start",
+    "hetero_speedup_vs_best_homog": "hetero",
 }
 
 

@@ -2,7 +2,7 @@
 """Resilience scenario runner: the bench's ``resilience`` section and a
 standalone CLI (ISSUE 13).
 
-Two seeded scenarios, both exactness-checked (recovery that corrupts
+Three seeded scenarios, all exactness-checked (recovery that corrupts
 results is not recovery):
 
 - **drain-and-readmit** — a 2-lane enqueue workload with an injected
@@ -26,6 +26,13 @@ results is not recovery):
   is how many post-resume windows the balancer needs to settle its
   split; the final image must equal the undisturbed run's closed form
   bit-identically (windows applied exactly once).
+
+- **mixed-kind drain** (ISSUE 20) — a heterogeneous fleet (two fast
+  accelerator-kind lanes + one slow host-CPU lane, kinds/priors
+  emulated on CPU-only rigs) with the CPU lane stalled: the slow lane
+  quarantines without dragging the fast lanes below their rate-implied
+  floor, and the availability floor never engages (two fast lanes stay
+  active throughout).
 
 Usage::
 
@@ -148,6 +155,107 @@ def drain_readmit_scenario(devices=None, stall_ms: float = 400.0,
     return out
 
 
+def mixed_drain_scenario(devices=None, stall_ms: float = 400.0,
+                         max_windows: int = 48, skew: float = 8.0) -> dict:
+    """Degradation containment on a HETEROGENEOUS fleet (ISSUE 20): two
+    fast accelerator-kind lanes + one slow host-CPU lane in one Cores,
+    the CPU lane stalled.  The drain must quarantine the slow lane at a
+    barrier WITHOUT dragging the fast lanes below their rate-implied
+    floor — a degraded 1x lane forfeits its own share, it never costs
+    the 8x lanes theirs (the shares are pinned at the rate-implied
+    split, so the floor is exact: post-drain fast ranges can only GROW
+    as they absorb the quarantined share).  The availability floor
+    never engages here (two fast lanes stay active), and the final
+    image must be bit-exact for every iteration the workload ran."""
+    from cekirdekler_tpu import ClArray
+    from cekirdekler_tpu.core.balance import prior_split
+    from cekirdekler_tpu.hardware import platforms
+    from cekirdekler_tpu.obs.drain import DrainController
+    from cekirdekler_tpu.obs.health import HealthMonitor
+    from cekirdekler_tpu.utils.faultinject import FAULTS
+
+    devs = devices if devices is not None else platforms().cpus()
+    if len(devs) < 3:
+        return {"skipped": "needs >= 3 lanes"}
+    cr = _mk_cruncher(devs, 3)
+    cores = cr.cores
+    # the emulation seam (tools/hetero_sweep.py): a real mixed rig gets
+    # these from jax.Device.device_kind via hardware.rate_prior
+    cores.lane_kinds = ["tpu-emu", "tpu-emu", "cpu"]
+    cores.rate_priors = [float(skew), float(skew), 1.0]
+    priors = list(cores.rate_priors)
+    total = sum(priors)
+    # pin the split AT the rate-implied share (same detector-noise
+    # rationale as drain_readmit_scenario; the live prior-seeded
+    # balancer is covered by hetero_sweep + tests/test_hetero.py) —
+    # with the pin, "rate-implied floor" is an exact per-lane number
+    cores.fixed_compute_powers = [p / total for p in priors]
+    floor = prior_split(N_ITEMS, LOCAL_RANGE, priors)
+    cores.health = HealthMonitor(threshold=4.0, window=2,
+                                 min_history=2, confirm=2)
+    cores.drain = DrainController(
+        cores.health, lanes=3, hold_barriers=1, confirm_clear=1)
+    x = ClArray(np.zeros(N_ITEMS, np.float32), name="x")
+    x.partial_read = True
+    cr.enqueue_mode = True
+    iters = 0
+    slow = 2  # the host-CPU lane's index
+
+    def window():
+        nonlocal iters
+        x.compute(cr, 1, "inc", N_ITEMS, LOCAL_RANGE)
+        iters += 1
+        cr.barrier()
+
+    out: dict = {"stall_ms": stall_ms, "lane_kinds": list(cores.lane_kinds),
+                 "rate_priors": priors, "rate_implied_floor": floor}
+    try:
+        for _ in range(8):  # baseline windows at the rate-implied split
+            window()
+        out["ranges_before"] = cores.ranges_of(1)
+        FAULTS.arm(f"seed=42;lane-stall@lane{slow}:delay_ms={stall_ms}")
+        drained_at = None
+        for i in range(max_windows):
+            window()
+            if cores.drain.lane_state(slow) != "active":
+                drained_at = i + 1
+                break
+        out["windows_to_drain"] = drained_at
+        if drained_at is not None:
+            window()  # the mask takes effect on the next call
+            ranges = cores.ranges_of(1)
+            out["ranges_after_drain"] = ranges
+            out["slow_lane_drained"] = ranges[slow] == 0
+            # the containment claim: the fast lanes never dip below the
+            # rate-implied floor — they absorb the freed share instead
+            out["fast_floor_ok"] = all(
+                ranges[i] >= floor[i] for i in range(3) if i != slow)
+            # the fast lanes were never touched by the quarantine
+            out["fast_lanes_active"] = all(
+                cores.drain.lane_state(i) == "active"
+                for i in range(3) if i != slow)
+        FAULTS.disarm()
+        readmit_at = None
+        for i in range(max_windows):
+            window()
+            if cores.drain.lane_state(slow) == "active":
+                readmit_at = i + 1
+                break
+        out["windows_to_readmit"] = readmit_at
+        cr.enqueue_mode = False  # flush
+        image = np.asarray(x)
+        out["iters"] = iters
+        out["exact"] = bool(
+            np.all(image == float(iters))
+            and out.get("slow_lane_drained")
+            and out.get("fast_floor_ok")
+            and out.get("fast_lanes_active"))
+    finally:
+        FAULTS.disarm()
+        cr.dispose()
+    return out
+
+
 def rejoin_scenario(devices=None, windows: int = 8, kill_after: int = 4,
                     ckpt_root: str | None = None) -> dict:
     """One kill-and-rejoin run (see module docstring)."""
@@ -228,14 +336,18 @@ def resilience_section(devices=None, stall_ms: float = 400.0,
     ``rejoin_converge_iters`` — the regression-watched keys)."""
     drain = drain_readmit_scenario(devices, stall_ms=stall_ms)
     rejoin = rejoin_scenario(devices, windows=windows)
-    exact = bool(drain.get("exact")) and bool(rejoin.get("exact"))
+    mixed = mixed_drain_scenario(devices, stall_ms=stall_ms)
+    exact = (bool(drain.get("exact")) and bool(rejoin.get("exact"))
+             and (bool(mixed.get("exact")) or "skipped" in mixed))
     return {
         "drain_recover_ms": drain.get("drain_recover_ms"),
         "rejoin_converge_iters": rejoin.get("rejoin_converge_iters"),
         "readmit_windows": drain.get("windows_to_readmit"),
+        "mixed_fast_floor_ok": mixed.get("fast_floor_ok"),
         "exact": exact,
         "drain": drain,
         "rejoin": rejoin,
+        "mixed_drain": mixed,
     }
 
 
@@ -273,8 +385,14 @@ def main(argv=None) -> int:
         print(f"drain_recover_ms      = {out['drain_recover_ms']}")
         print(f"rejoin_converge_iters = {out['rejoin_converge_iters']}")
         print(f"readmit_windows       = {out['readmit_windows']}")
+        print(f"mixed_fast_floor_ok   = {out['mixed_fast_floor_ok']}")
         print(f"exact                 = {out['exact']}")
     skipped = [k for k in ("drain", "rejoin") if out[k].get("skipped")]
+    if out["mixed_drain"].get("skipped"):
+        # the mixed-kind scenario degrades to a note, not an exit-2: the
+        # two homogeneous scenarios already ran on this rig
+        print(f"note: mixed_drain skipped "
+              f"({out['mixed_drain']['skipped']})")
     if skipped:
         # an environment gap is NOT a recovery failure — name it and
         # exit distinctly (2) so a gate never confuses the two
